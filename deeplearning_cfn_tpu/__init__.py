@@ -1,0 +1,29 @@
+"""deeplearning_cfn_tpu — a TPU-native distributed deep-learning cluster framework.
+
+A ground-up rebuild of the capability set of AWS's deeplearning-cfn
+(CloudFormation cluster provisioning + worker discovery + distributed
+training launch; see /root/reference) designed for TPU hardware:
+
+- Provisioner: typed cluster templates -> a live TPU slice (pluggable
+  backends; in-memory local backend for tests, GCP TPU VM backend for real
+  deployments).  Replaces cfn-template/deeplearning.template.
+- Discovery: every worker runs the same bootstrap agent, enumerating peers
+  through a rendezvous queue with at-least-once/broadcast semantics and
+  strict timeout budgets.  Replaces cfn-bootstrap/dl_cfn_setup_v2.py.
+- Elasticity: an event-driven controller implementing degrade-and-continue
+  on partial capacity.  Replaces cfn-lambda_function/lambda_function.py.
+- Launch: one SPMD program on all workers over `jax.distributed` — no SSH
+  fan-out, no MPI, no parameter servers.  Replaces run.sh / mpirun /
+  generate_trainer.py.
+- Compute: JAX/XLA/pjit data-parallel + FSDP + tensor/sequence parallel
+  trainers over a `jax.sharding.Mesh`; collectives ride ICI, not NCCL.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning_cfn_tpu.config.schema import (  # noqa: F401
+    ClusterSpec,
+    JobSpec,
+    StorageSpec,
+    NodePool,
+)

@@ -1,0 +1,2 @@
+from deeplearning_cfn_tpu.cluster.queue import InMemoryQueue, Message, RendezvousQueue  # noqa: F401
+from deeplearning_cfn_tpu.cluster.contract import ClusterContract  # noqa: F401

@@ -1,0 +1,230 @@
+"""Bootstrap / discovery agent — runs on every worker at startup.
+
+TPU-native rebuild of cfn-bootstrap/dl_cfn_setup_v2.py.  Every worker VM in
+a slice runs this same agent; role is decided by worker index (worker 0 is
+the coordinator — the "master is also worker #1" rule, StackSetup.md:110-111)
+rather than an AWS_DL_NODE_TYPE env var.
+
+Coordinator phases (dl_cfn_setup_v2.py:389-436):
+
+1. ``wait_for_credentials`` — poll until the platform identity is usable
+   (check_instance_role_availability, :359-386).
+2. ``wait_for_group_success`` — poll the coordinator queue until a
+   ``group-setup`` success message is seen for EVERY registered group,
+   deduping at-least-once redelivery by group name (:123-168, dedup
+   :142-149); consumed messages are deleted (:150).
+3. ``wait_until_instances_active`` — poll the backend until every healthy
+   instance is RUNNING and has an IP (:210-281).
+4. Build + publish the contract, broadcast ``worker-setup`` on the worker
+   queue (:346-357), and signal the cluster WaitCondition (:286-298).
+
+Worker phases: wait for the broadcast with ``visibility_timeout=0`` and
+never delete it so one message reaches all workers (:170-208, trick
+:180-190), then publish the same contract locally.
+
+All waits draw from one :class:`TimeoutBudget`
+(setup_timeout = cluster_ready - controller_launch, :411-415), and each
+phase raises a typed error naming itself on exhaustion — the analog of the
+per-phase error exits (:309-311, 327-329, 426-428).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable
+
+from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+from deeplearning_cfn_tpu.cluster.elasticity import GROUP_SETUP_EVENT
+from deeplearning_cfn_tpu.cluster.queue import RendezvousQueue
+from deeplearning_cfn_tpu.provision.backend import Backend, InstanceState, ResourceSignal
+from deeplearning_cfn_tpu.utils.logging import get_logger
+from deeplearning_cfn_tpu.utils.timeouts import TimeoutBudget
+
+log = get_logger("dlcfn.bootstrap")
+
+CLUSTER_READY_RESOURCE = "cluster-wait-condition"
+
+
+class BootstrapError(RuntimeError):
+    def __init__(self, phase: str, message: str):
+        super().__init__(f"[{phase}] {message}")
+        self.phase = phase
+
+
+@dataclass
+class GroupSetupResult:
+    group: str
+    launched: int
+    degraded: bool
+
+
+@dataclass
+class BootstrapAgent:
+    backend: Backend
+    cluster_name: str
+    coordinator_queue: RendezvousQueue
+    worker_queue: RendezvousQueue
+    group_names: list[str]
+    budget: TimeoutBudget
+    poll_interval_s: float = 30.0
+    storage_mount: str = "/mnt/dlcfn"
+    contract_root: Path | None = None
+    credential_probe: Callable[[], bool] = lambda: True
+    # SQS batch size from the reference (dl_cfn_setup_v2.py:36-37,139-141)
+    receive_batch: int = 10
+    visibility_timeout_s: float = 60.0
+
+    # --- phase 1: credentials -------------------------------------------
+    def wait_for_credentials(self) -> None:
+        phase = "credentials"
+        while not self.credential_probe():
+            log.info("platform credentials not yet available; retrying")
+            self.budget.sleep(self.poll_interval_s, phase)
+        self.budget.check(phase)
+
+    # --- phase 2: group success messages (coordinator) -------------------
+    def wait_for_group_success(self) -> dict[str, GroupSetupResult]:
+        phase = "group-success"
+        pending = set(self.group_names)
+        results: dict[str, GroupSetupResult] = {}
+        while pending:
+            self.budget.check(phase)
+            # Fail fast if the controller already rendered a FAILURE verdict
+            # (below-minimum capacity) — the definitive signal is on the
+            # group resource; waiting out the whole budget would burn ~45
+            # real minutes for an answer that is already known.
+            for name in pending:
+                if (
+                    self.backend.get_resource_signal(f"group:{name}")
+                    is ResourceSignal.FAILURE
+                ):
+                    raise BootstrapError(
+                        phase, f"group {name} failed to reach minimum capacity"
+                    )
+            messages = self.coordinator_queue.receive(
+                max_messages=self.receive_batch,
+                visibility_timeout_s=self.visibility_timeout_s,
+            )
+            for msg in messages:
+                body = msg.body
+                if body.get("event") != GROUP_SETUP_EVENT:
+                    log.info("ignoring non-setup message: %s", body.get("event"))
+                    self.coordinator_queue.delete(msg.receipt)
+                    continue
+                group = body.get("group")
+                if group in results:
+                    # At-least-once redelivery: dedup by group name
+                    # (dl_cfn_setup_v2.py:142-149).
+                    log.info("duplicate group-setup for %s deduped", group)
+                elif group in pending:
+                    if body.get("status") != "success":
+                        raise BootstrapError(
+                            phase, f"group {group} reported {body.get('status')!r}"
+                        )
+                    results[group] = GroupSetupResult(
+                        group=str(group),
+                        launched=int(body.get("launched", 0)),
+                        degraded=bool(body.get("degraded", False)),
+                    )
+                    pending.discard(str(group))
+                    log.info(
+                        "group %s ready (launched=%d degraded=%s); %d group(s) pending",
+                        group,
+                        results[str(group)].launched,
+                        results[str(group)].degraded,
+                        len(pending),
+                    )
+                else:
+                    log.info("group-setup for unknown group %s ignored", group)
+                self.coordinator_queue.delete(msg.receipt)
+            if pending:
+                self.budget.sleep(self.poll_interval_s, phase)
+        return results
+
+    # --- phase 3: instances active ---------------------------------------
+    def wait_until_instances_active(self) -> dict[str, list[str]]:
+        """Poll until every healthy instance of every group is RUNNING with
+        an IP; returns {group: [ips]} (dl_cfn_setup_v2.py:210-281)."""
+        phase = "instances-active"
+        ips: dict[str, list[str]] = {}
+        while True:
+            self.budget.check(phase)
+            ips.clear()
+            all_running = True
+            for name in self.group_names:
+                group = self.backend.describe_group(name)
+                healthy = group.healthy_instances
+                running = [
+                    i
+                    for i in healthy
+                    if i.state is InstanceState.RUNNING and i.private_ip
+                ]
+                if len(running) < group.desired:
+                    all_running = False
+                    log.info(
+                        "group %s: %d/%d running", name, len(running), group.desired
+                    )
+                    break
+                ips[name] = [i.private_ip for i in running if i.private_ip]
+            if all_running:
+                return ips
+            self.budget.sleep(self.poll_interval_s, phase)
+
+    # --- phase 4: contract + broadcast + signal ---------------------------
+    def _publish_contract(self, contract: ClusterContract) -> None:
+        contract.write(self.contract_root)
+
+    def run_coordinator(self, my_ip: str) -> ClusterContract:
+        self.wait_for_credentials()
+        results = self.wait_for_group_success()
+        ips_by_group = self.wait_until_instances_active()
+        all_ips = [ip for name in self.group_names for ip in ips_by_group[name]]
+        degraded = any(r.degraded for r in results.values())
+        chips = max(
+            self.backend.describe_group(name).chips_per_worker
+            for name in self.group_names
+        )
+        contract = ClusterContract.build(
+            cluster_name=self.cluster_name,
+            coordinator_ip=my_ip,
+            other_worker_ips=all_ips,
+            chips_per_worker=chips,
+            storage_mount=self.storage_mount,
+            degraded=degraded,
+        )
+        self._publish_contract(contract)
+        self.worker_queue.send(contract.to_message())
+        self.backend.signal_resource(CLUSTER_READY_RESOURCE, ResourceSignal.SUCCESS)
+        log.info(
+            "cluster %s ready: %d workers x %d chips%s",
+            self.cluster_name,
+            contract.workers_count,
+            contract.chips_per_worker,
+            " (DEGRADED)" if degraded else "",
+        )
+        return contract
+
+    def run_worker(self) -> ClusterContract:
+        self.wait_for_credentials()
+        phase = "worker-setup"
+        while True:
+            self.budget.check(phase)
+            # visibility_timeout=0 + no delete of the broadcast: the trick
+            # that lets one worker-setup message reach every worker
+            # (dl_cfn_setup_v2.py:180-190).  Scan a full batch so a stray
+            # message at the head of the queue cannot shadow the broadcast
+            # forever; strays are deleted (worker-setup is the only message
+            # type ever broadcast on this queue, so junk is junk for every
+            # consumer).
+            messages = self.worker_queue.receive(
+                max_messages=self.receive_batch, visibility_timeout_s=0.0
+            )
+            for msg in messages:
+                if msg.body.get("event") == "worker-setup":
+                    contract = ClusterContract.from_message(msg.body)
+                    self._publish_contract(contract)
+                    return contract
+                log.info("deleting stray message %s on worker queue", msg.body.get("event"))
+                self.worker_queue.delete(msg.receipt)
+            self.budget.sleep(self.poll_interval_s, phase)

@@ -1,0 +1,165 @@
+"""The cluster contract: what discovery publishes for training jobs.
+
+The reference's bootstrap ends by writing three artifacts every trainer
+consumes (dl_cfn_setup_v2.py:92-116, documented README.md:89-97):
+
+1. ``/etc/hosts`` names ``deeplearning-master`` / ``deeplearning-workerN``
+   (dl_cfn_setup_v2.py:95-101) — consumed by run.sh's hostfile (run.sh:46-53).
+2. ``/opt/deeplearning/workers`` — one hostname per line.
+3. ``/etc/profile.d/deeplearning.sh`` exporting DEEPLEARNING_WORKERS_COUNT,
+   DEEPLEARNING_WORKERS_PATH, DEEPLEARNING_WORKER_GPU_COUNT, EFS_MOUNT.
+
+This module reproduces that contract (chips instead of GPUs) and extends it
+with the field JAX actually needs that MPI got from mpirun: the coordinator
+address + process count + process id for ``jax.distributed.initialize``.
+The master-is-also-worker-0 rule and deterministic IP ordering are kept:
+the coordinator's IP is prepended and the remainder sorted
+(dl_cfn_setup_v2.py:330-342), so every node derives the identical worker
+list independently.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+from dataclasses import dataclass, field, asdict
+from pathlib import Path
+
+COORDINATOR_HOSTNAME = "deeplearning-master"
+WORKER_HOSTNAME_FMT = "deeplearning-worker{index}"
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+@dataclass
+class ClusterContract:
+    cluster_name: str
+    coordinator_ip: str
+    worker_ips: list[str]  # coordinator first, rest sorted
+    chips_per_worker: int
+    storage_mount: str
+    coordinator_port: int = DEFAULT_COORDINATOR_PORT
+    degraded: bool = False
+    tags: dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def build(
+        cls,
+        cluster_name: str,
+        coordinator_ip: str,
+        other_worker_ips: list[str],
+        chips_per_worker: int,
+        storage_mount: str,
+        degraded: bool = False,
+    ) -> "ClusterContract":
+        # Coordinator doubles as worker 0 (StackSetup.md:110-111); its IP is
+        # prepended and the rest sorted for a stable order (dl_cfn_setup_v2.py:330-342).
+        rest = sorted(ip for ip in other_worker_ips if ip != coordinator_ip)
+        return cls(
+            cluster_name=cluster_name,
+            coordinator_ip=coordinator_ip,
+            worker_ips=[coordinator_ip] + rest,
+            chips_per_worker=chips_per_worker,
+            storage_mount=storage_mount,
+            degraded=degraded,
+        )
+
+    # --- derived views ----------------------------------------------------
+    @property
+    def workers_count(self) -> int:
+        return len(self.worker_ips)
+
+    @property
+    def total_chips(self) -> int:
+        return self.workers_count * self.chips_per_worker
+
+    def hostnames(self) -> list[str]:
+        # worker0 answers to both names, as in the reference where the master
+        # appears in /etc/hosts as deeplearning-master AND heads the list.
+        return [COORDINATOR_HOSTNAME] + [
+            WORKER_HOSTNAME_FMT.format(index=i + 1) for i in range(self.workers_count - 1)
+        ]
+
+    def hosts_entries(self) -> list[tuple[str, str]]:
+        return list(zip(self.worker_ips, self.hostnames()))
+
+    def env(self, root: Path | None = None) -> dict[str, str]:
+        """The DEEPLEARNING_* contract (dl_cfn_setup_v2.py:104-109), chips
+        instead of GPUs, plus the jax.distributed coordination triple.
+
+        ``root`` must be the directory the contract was (or will be)
+        published to, so DEEPLEARNING_WORKERS_PATH points at the workers
+        file that actually exists."""
+        root = root or self.root_dir()
+        return {
+            "DEEPLEARNING_WORKERS_COUNT": str(self.workers_count),
+            "DEEPLEARNING_WORKERS_PATH": str(root / "workers"),
+            "DEEPLEARNING_WORKER_CHIP_COUNT": str(self.chips_per_worker),
+            "DEEPLEARNING_STORAGE_MOUNT": self.storage_mount,
+            "DEEPLEARNING_COORDINATOR": f"{self.coordinator_ip}:{self.coordinator_port}",
+            "DEEPLEARNING_CLUSTER_NAME": self.cluster_name,
+            "DEEPLEARNING_DEGRADED": "1" if self.degraded else "0",
+        }
+
+    def jax_initialize_kwargs(self, process_id: int) -> dict[str, object]:
+        """Arguments for jax.distributed.initialize — the rendezvous MPI's
+        mpirun provided in the reference (run.sh:72-77), without SSH."""
+        return {
+            "coordinator_address": f"{self.coordinator_ip}:{self.coordinator_port}",
+            "num_processes": self.workers_count,
+            "process_id": process_id,
+        }
+
+    # --- filesystem publication ------------------------------------------
+    @staticmethod
+    def root_dir() -> Path:
+        return Path(os.environ.get("DLCFN_ROOT", "/opt/deeplearning"))
+
+    def workers_file_path(self) -> Path:
+        return self.root_dir() / "workers"
+
+    def write(self, root: Path | None = None) -> Path:
+        root = root or self.root_dir()
+        root.mkdir(parents=True, exist_ok=True)
+        (root / "workers").write_text(
+            "".join(f"{h}\n" for h in self.hostnames())
+        )
+        (root / "hosts").write_text(
+            "".join(f"{ip} {host}\n" for ip, host in self.hosts_entries())
+        )
+        (root / "env.sh").write_text(
+            "".join(
+                f"export {k}={shlex.quote(v)}\n" for k, v in self.env(root).items()
+            )
+        )
+        (root / "contract.json").write_text(json.dumps(asdict(self), indent=2))
+        return root
+
+    @classmethod
+    def read(cls, root: Path | None = None) -> "ClusterContract":
+        root = root or cls.root_dir()
+        return cls(**json.loads((root / "contract.json").read_text()))
+
+    def to_message(self) -> dict[str, object]:
+        """The worker-setup broadcast body (dl_cfn_setup_v2.py:346-357)."""
+        return {
+            "event": "worker-setup",
+            "status": "success",
+            "coordinator-ip": self.coordinator_ip,
+            "worker-ips": self.worker_ips,
+            "chips-per-worker": self.chips_per_worker,
+            "storage-mount": self.storage_mount,
+            "degraded": self.degraded,
+            "cluster": self.cluster_name,
+        }
+
+    @classmethod
+    def from_message(cls, body: dict[str, object]) -> "ClusterContract":
+        return cls(
+            cluster_name=str(body["cluster"]),
+            coordinator_ip=str(body["coordinator-ip"]),
+            worker_ips=list(body["worker-ips"]),  # type: ignore[arg-type]
+            chips_per_worker=int(body["chips-per-worker"]),  # type: ignore[arg-type]
+            storage_mount=str(body["storage-mount"]),
+            degraded=bool(body.get("degraded", False)),
+        )

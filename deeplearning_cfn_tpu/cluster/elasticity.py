@@ -1,0 +1,151 @@
+"""Elasticity controller — the event-driven capacity policy.
+
+TPU-native rebuild of cfn-lambda_function/lambda_function.py.  Subscribes to
+the provisioner's event bus (the SNS-topic analog) and implements the same
+policy, per worker group:
+
+- On INSTANCE_LAUNCH (lambda_function.py:94-134): count healthy
+  launched/pending instances; when launched == desired, post a
+  ``group-setup`` success message to the coordinator queue (:51-62,119),
+  signal the group's readiness resource (the CloudFormation
+  ``signal_resource`` analog, :121-128), and freeze group membership so
+  discovery and autoscaling cannot race (suspend ReplaceUnhealthy, :129-132).
+- On INSTANCE_LAUNCH_ERROR (:142-169): **degrade-and-continue** — if healthy
+  >= group minimum, shrink desired capacity to what actually launched,
+  freeze membership, and still report success; otherwise signal FAILURE.
+- On INSTANCE_TERMINATE after the membership freeze: record the loss and
+  surface recreate-and-resume guidance (the reference documents but does not
+  automate this: StackSetup.md:107-117).
+
+Like the Lambda, the controller is stateless across events with respect to
+success reporting: a duplicated event can produce a duplicated success
+message.  Consumers dedup by group name, exactly as the master bootstrap did
+(dl_cfn_setup_v2.py:142-149).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from deeplearning_cfn_tpu.provision.backend import Backend, InstanceState, ResourceSignal
+from deeplearning_cfn_tpu.provision.events import EventKind, LifecycleEvent
+from deeplearning_cfn_tpu.utils.logging import get_logger
+
+log = get_logger("dlcfn.elasticity")
+
+GROUP_SETUP_EVENT = "group-setup"
+
+
+@dataclass
+class GroupPolicy:
+    name: str
+    minimum: int
+    signal_resource: str  # resource name to signal when this group settles
+    coordinator: bool = False  # True for the group hosting worker 0
+
+
+@dataclass
+class ElasticityController:
+    backend: Backend
+    coordinator_queue_name: str
+    policies: dict[str, GroupPolicy] = field(default_factory=dict)
+    lost_instances: list[str] = field(default_factory=list)
+    degraded_groups: set[str] = field(default_factory=set)
+
+    def register(self, policy: GroupPolicy) -> None:
+        self.policies[policy.name] = policy
+
+    def attach(self) -> None:
+        self.backend.events.subscribe(self.handle)
+
+    # --- event dispatch (lambda_handler + get_handler analog) -----------
+    def handle(self, event: LifecycleEvent) -> None:
+        policy = self.policies.get(event.group)
+        if policy is None:
+            log.debug("event for unmanaged group %s ignored", event.group)
+            return
+        if event.kind is EventKind.INSTANCE_LAUNCH:
+            self._on_launch(policy)
+        elif event.kind is EventKind.INSTANCE_LAUNCH_ERROR:
+            self._on_launch_error(policy, event)
+        elif event.kind in (EventKind.INSTANCE_TERMINATE, EventKind.INSTANCE_TERMINATE_ERROR):
+            self._on_terminate(policy, event)
+        elif event.kind is EventKind.TEST_NOTIFICATION:
+            log.info("test notification for group %s", event.group)
+
+    # --- helpers ---------------------------------------------------------
+    def _counts(self, name: str) -> tuple[int, int]:
+        group = self.backend.describe_group(name)
+        healthy = [
+            i
+            for i in group.instances
+            if i.healthy and i.state in (InstanceState.PENDING, InstanceState.RUNNING)
+        ]
+        return len(healthy), group.desired
+
+    def _send_success(self, policy: GroupPolicy, launched: int) -> None:
+        queue = self.backend.get_queue(self.coordinator_queue_name)
+        queue.send(
+            {
+                "event": GROUP_SETUP_EVENT,
+                "status": "success",
+                "group": policy.name,
+                "launched": launched,
+                "degraded": policy.name in self.degraded_groups,
+            }
+        )
+        self.backend.signal_resource(policy.signal_resource, ResourceSignal.SUCCESS)
+        self.backend.suspend_replace_unhealthy(policy.name)
+        log.info(
+            "group %s settled: launched=%d degraded=%s",
+            policy.name,
+            launched,
+            policy.name in self.degraded_groups,
+        )
+
+    # --- handlers ---------------------------------------------------------
+    def _on_launch(self, policy: GroupPolicy) -> None:
+        launched, desired = self._counts(policy.name)
+        log.info("launch event: group=%s launched=%d desired=%d", policy.name, launched, desired)
+        if launched == desired:
+            self._send_success(policy, launched)
+
+    def _on_launch_error(self, policy: GroupPolicy, event: LifecycleEvent) -> None:
+        launched, desired = self._counts(policy.name)
+        log.warning(
+            "launch error in group %s (%s): launched=%d desired=%d min=%d",
+            policy.name,
+            event.detail.get("cause", "unknown"),
+            launched,
+            desired,
+            policy.minimum,
+        )
+        if launched >= policy.minimum:
+            # Degrade and continue (lambda_function.py:161-167; README.md:49):
+            # accept the capacity that materialized and freeze it.
+            if launched != desired:
+                self.backend.set_desired_capacity(policy.name, launched)
+                self.degraded_groups.add(policy.name)
+            self._send_success(policy, launched)
+        else:
+            self.backend.signal_resource(policy.signal_resource, ResourceSignal.FAILURE)
+            log.error(
+                "group %s below minimum (%d < %d): signaling FAILURE",
+                policy.name,
+                launched,
+                policy.minimum,
+            )
+
+    def _on_terminate(self, policy: GroupPolicy, event: LifecycleEvent) -> None:
+        # The reference only logs terminations (lambda_function.py:173-199) and
+        # documents that membership is NOT updated (StackSetup.md:107-108).  We
+        # log, record, and leave recovery to checkpoint-resume — but make the
+        # loss programmatically visible instead of burying it in CloudWatch.
+        if event.instance_id:
+            self.lost_instances.append(event.instance_id)
+        log.warning(
+            "instance %s terminated in group %s; cluster contract is now stale — "
+            "recreate the cluster (reusing storage) and resume from checkpoint",
+            event.instance_id,
+            policy.name,
+        )

@@ -1,0 +1,18 @@
+from deeplearning_cfn_tpu.provision.events import LifecycleEvent, EventKind  # noqa: F401
+from deeplearning_cfn_tpu.provision.backend import Backend, Instance, WorkerGroup  # noqa: F401
+
+# Provisioner lives in deeplearning_cfn_tpu.provision.provisioner; it is not
+# re-exported here to keep the cluster<->provision import graph acyclic
+# (bootstrap/elasticity import provision.backend, the provisioner imports them).
+
+
+def __getattr__(name):
+    if name in ("Provisioner", "ProvisionResult"):
+        from deeplearning_cfn_tpu.provision import provisioner
+
+        return getattr(provisioner, name)
+    if name == "LocalBackend":
+        from deeplearning_cfn_tpu.provision.local import LocalBackend
+
+        return LocalBackend
+    raise AttributeError(name)

@@ -1,0 +1,57 @@
+"""Cluster lifecycle events.
+
+The reference's elasticity loop is driven by ASG lifecycle notifications
+fanned through SNS to a Lambda (deeplearning.template:681-689,755-768); the
+Lambda dispatches on ``message['Event']`` strings like
+``autoscaling:EC2_INSTANCE_LAUNCH`` (lambda_function.py:37-44).  This module
+defines the typed TPU-native equivalents plus the event bus that replaces
+SNS: synchronous fan-out to subscribed handlers, with the same at-least-once
+caveat (a backend may deliver an event twice; handlers must be idempotent).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+class EventKind(enum.Enum):
+    # Names mirror the ASG event vocabulary the reference dispatches on
+    # (lambda_function.py:37-44) so operators can map alarms 1:1.
+    INSTANCE_LAUNCH = "instance-launch"
+    INSTANCE_LAUNCH_ERROR = "instance-launch-error"
+    INSTANCE_TERMINATE = "instance-terminate"
+    INSTANCE_TERMINATE_ERROR = "instance-terminate-error"
+    TEST_NOTIFICATION = "test-notification"  # autoscaling:TEST_NOTIFICATION analog
+
+
+@dataclass
+class LifecycleEvent:
+    kind: EventKind
+    group: str  # worker-group (ASG analog) name
+    instance_id: str | None = None
+    detail: dict[str, Any] = field(default_factory=dict)
+
+
+EventHandler = Callable[[LifecycleEvent], None]
+
+
+class EventBus:
+    """Synchronous SNS-topic analog: publish fans out to all subscribers.
+
+    Delivery is at-least-once by contract — tests exercise duplicate
+    publishes — so subscribers (the elasticity controller) must be
+    idempotent, exactly as the reference's Lambda had to tolerate SQS/SNS
+    redelivery (dedup at dl_cfn_setup_v2.py:142-149 exists because of this).
+    """
+
+    def __init__(self) -> None:
+        self._subscribers: list[EventHandler] = []
+
+    def subscribe(self, handler: EventHandler) -> None:
+        self._subscribers.append(handler)
+
+    def publish(self, event: LifecycleEvent) -> None:
+        for handler in list(self._subscribers):
+            handler(event)

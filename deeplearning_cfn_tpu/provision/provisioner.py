@@ -1,0 +1,267 @@
+"""Provisioner: a validated ClusterSpec -> a live, discovered cluster.
+
+The CloudFormation-engine analog.  Materializes resources in dependency
+order exactly as the reference template does (SURVEY §3.1: IAM -> SQS ->
+SNS+Lambda -> network -> EFS -> master ASG -> worker ASG,
+deeplearning.template:179-901):
+
+1. rendezvous queues (SQS analog, deeplearning.template:743-754)
+2. elasticity controller subscribed to the event bus (SNS+Lambda, :755-768)
+3. shared storage, create-or-reuse (EFS + EFSFileSystemId condition,
+   :453-474, :95-111)
+4. the worker group(s) — creating a group fires lifecycle events into the
+   controller, which posts group-setup messages consumed by bootstrap
+5. bootstrap agents (cfn-init running dl_cfn_setup_v2.py, :521-567)
+
+``wait_until_ready`` is the WaitCondition (deeplearning.template:769-780):
+provisioning only counts as complete when the coordinator's agent signals
+success within the budget; otherwise a typed failure is raised (the
+rollback analog).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+
+from deeplearning_cfn_tpu.cluster.bootstrap import (
+    CLUSTER_READY_RESOURCE,
+    BootstrapAgent,
+    BootstrapError,
+)
+from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+from deeplearning_cfn_tpu.cluster.elasticity import ElasticityController, GroupPolicy
+from deeplearning_cfn_tpu.config.schema import ClusterSpec, ConfigError, NodePool
+from deeplearning_cfn_tpu.provision.backend import Backend, ResourceSignal, StorageHandle
+from deeplearning_cfn_tpu.utils.logging import get_logger
+from deeplearning_cfn_tpu.utils.timeouts import BudgetExhausted, TimeoutBudget
+
+log = get_logger("dlcfn.provision")
+
+WORKER_GROUP_SUFFIX = "workers"
+
+
+def worker_group_name(cluster_name: str) -> str:
+    return f"{cluster_name}-{WORKER_GROUP_SUFFIX}"
+
+
+@dataclass
+class ProvisionResult:
+    spec: ClusterSpec
+    contract: ClusterContract
+    storage: StorageHandle
+    controller: ElasticityController
+    degraded: bool
+    job_violation: str | None = None
+
+    @property
+    def realized_workers(self) -> int:
+        # Degrade-and-continue means the realized size can be smaller than
+        # requested; the operator discovers it here rather than by counting
+        # instances in the console (StackSetup.md:55-65).
+        return self.contract.workers_count
+
+    @property
+    def realized_pool(self) -> NodePool:
+        """The pool as it actually materialized (post-degradation)."""
+        pool = self.spec.pool
+        return NodePool(
+            accelerator_type=pool.accelerator_type,
+            workers=self.contract.workers_count,
+            min_workers=pool.min_workers,
+            placement_policy=pool.placement_policy,
+            runtime_version=pool.runtime_version,
+        )
+
+
+class ProvisionFailure(RuntimeError):
+    pass
+
+
+class Provisioner:
+    def __init__(self, backend: Backend, spec: ClusterSpec, contract_root: Path | None = None):
+        self.backend = backend
+        self.spec = spec.validate()
+        self.contract_root = contract_root
+        self._storage: StorageHandle | None = None
+
+    # -- resource names ---------------------------------------------------
+    @property
+    def group_name(self) -> str:
+        return worker_group_name(self.spec.name)
+
+    @property
+    def coordinator_queue_name(self) -> str:
+        return f"{self.spec.name}-coordinator-queue"
+
+    @property
+    def worker_queue_name(self) -> str:
+        return f"{self.spec.name}-worker-queue"
+
+    # -- create -----------------------------------------------------------
+    def provision(self) -> ProvisionResult:
+        spec = self.spec
+        pool = spec.pool
+
+        coord_q = self.backend.create_queue(self.coordinator_queue_name)
+        worker_q = self.backend.create_queue(self.worker_queue_name)
+
+        controller = ElasticityController(
+            backend=self.backend,
+            coordinator_queue_name=self.coordinator_queue_name,
+        )
+        controller.register(
+            GroupPolicy(
+                name=self.group_name,
+                minimum=pool.min_workers or pool.num_workers,
+                signal_resource=f"group:{self.group_name}",
+                coordinator=True,
+            )
+        )
+        controller.attach()
+
+        self._storage = self.backend.create_or_reuse_storage(
+            kind=spec.storage.kind,
+            existing_id=spec.storage.existing_id,
+            mount_point=spec.storage.mount_point,
+            retain=spec.storage.retain_on_delete,
+        )
+        log.info(
+            "storage %s %s at %s",
+            self._storage.storage_id,
+            "created" if self._storage.created else "reused",
+            self._storage.mount_point,
+        )
+
+        # Creating the group fires INSTANCE_LAUNCH / INSTANCE_LAUNCH_ERROR
+        # events into the controller (the ASG -> SNS -> Lambda path).
+        self.backend.create_group(
+            self.group_name,
+            desired=pool.num_workers,
+            minimum=pool.min_workers or pool.num_workers,
+            chips_per_worker=pool.chips_per_worker,
+        )
+
+        contract = self._run_bootstrap(coord_q, worker_q)
+        result = ProvisionResult(
+            spec=spec,
+            contract=contract,
+            storage=self._storage,
+            controller=controller,
+            degraded=contract.degraded,
+        )
+        if result.degraded:
+            # A shrunken cluster can violate job invariants the original
+            # spec satisfied (batch divisibility, even-worker rule).  The
+            # cluster still comes up — degrade-and-continue is the contract —
+            # but the violation is surfaced here and enforced at launch time,
+            # mirroring run.sh:43-44 checking invariants just before mpirun.
+            try:
+                spec.job.validate(result.realized_pool)
+            except ConfigError as e:
+                result.job_violation = str(e)
+                log.warning(
+                    "degraded cluster violates job invariants: %s — adjust the "
+                    "job before launch",
+                    e,
+                )
+        self.wait_until_ready()
+        return result
+
+    def _run_bootstrap(self, coord_q, worker_q) -> ClusterContract:
+        spec = self.spec
+        clock = getattr(self.backend, "clock", None)
+        budget = (
+            TimeoutBudget(spec.timeouts.bootstrap_budget_s, clock=clock)
+            if clock is not None
+            else TimeoutBudget(spec.timeouts.bootstrap_budget_s)
+        )
+        group = self.backend.describe_group(self.group_name)
+        running = [i for i in group.healthy_instances]
+        if not running:
+            raise ProvisionFailure("no healthy instances launched")
+        coordinator_ip = None
+        agent = BootstrapAgent(
+            backend=self.backend,
+            cluster_name=spec.name,
+            coordinator_queue=coord_q,
+            worker_queue=worker_q,
+            group_names=[self.group_name],
+            budget=budget,
+            poll_interval_s=spec.timeouts.poll_interval_s,
+            storage_mount=spec.storage.mount_point,
+            contract_root=self.contract_root,
+        )
+        # Worker 0 (lowest index healthy instance) runs the coordinator role.
+        coordinator = min(running, key=lambda i: i.index)
+        coordinator_ip = coordinator.private_ip
+        if coordinator_ip is None:
+            # It may still be PENDING; the active-wait inside the coordinator
+            # role resolves IPs, but we need ours first.
+            refreshed = self.backend.describe_instances([coordinator.instance_id])
+            coordinator_ip = refreshed[0].private_ip if refreshed else None
+        if coordinator_ip is None:
+            raise ProvisionFailure("coordinator instance has no IP")
+        try:
+            contract = agent.run_coordinator(coordinator_ip)
+        except (BootstrapError, BudgetExhausted) as e:
+            # The reference's master exits 1 and the WaitCondition times out,
+            # rolling the stack back (dl_cfn_setup_v2.py:426-428,
+            # deeplearning.template:769-780).
+            self.backend.signal_resource(CLUSTER_READY_RESOURCE, ResourceSignal.FAILURE)
+            raise ProvisionFailure(str(e)) from e
+        # Remaining workers consume the broadcast (in a real deployment each
+        # runs in its own VM; the local backend runs them inline).
+        for _ in range(contract.workers_count - 1):
+            worker_agent = BootstrapAgent(
+                backend=self.backend,
+                cluster_name=spec.name,
+                coordinator_queue=coord_q,
+                worker_queue=worker_q,
+                group_names=[self.group_name],
+                budget=budget,
+                poll_interval_s=spec.timeouts.poll_interval_s,
+                storage_mount=spec.storage.mount_point,
+                contract_root=self.contract_root,
+            )
+            worker_agent.run_worker()
+        return contract
+
+    # -- WaitCondition ----------------------------------------------------
+    def wait_until_ready(self) -> None:
+        signal = self.backend.get_resource_signal(CLUSTER_READY_RESOURCE)
+        if signal is not ResourceSignal.SUCCESS:
+            raise ProvisionFailure(
+                f"cluster {self.spec.name!r} did not signal ready "
+                f"(signal={signal}); provisioning rolled back"
+            )
+
+    # -- describe / delete (C11-equivalent operations) ---------------------
+    def describe(self) -> dict[str, object]:
+        group = self.backend.describe_group(self.group_name)
+        return {
+            "name": self.spec.name,
+            "workers": {
+                "desired": group.desired,
+                "healthy": len(group.healthy_instances),
+                "frozen": group.replace_unhealthy_suspended,
+            },
+            "storage": self._storage.storage_id if self._storage else None,
+            "ready": self.backend.get_resource_signal(CLUSTER_READY_RESOURCE)
+            is ResourceSignal.SUCCESS,
+        }
+
+    def delete(self, force_storage: bool = False) -> dict[str, object]:
+        self.backend.delete_group(self.group_name)
+        storage_deleted = False
+        if self._storage is not None:
+            storage_deleted = self.backend.delete_storage(
+                self._storage.storage_id, force=force_storage
+            )
+            if not storage_deleted:
+                log.info(
+                    "storage %s retained (DeletionPolicy: Retain analog; "
+                    "checkpoints survive cluster deletion)",
+                    self._storage.storage_id,
+                )
+        return {"storage_deleted": storage_deleted}

@@ -1,0 +1,2 @@
+from deeplearning_cfn_tpu.utils.logging import get_logger  # noqa: F401
+from deeplearning_cfn_tpu.utils.timeouts import TimeoutBudget  # noqa: F401

@@ -1,0 +1,73 @@
+"""Structured logging for the cluster control plane.
+
+Keeps the reference's `time level file:line msg` line format
+(dl_cfn_setup_v2.py:56-70 wrote to both /var/log/dl_cfn_setup.log and the
+console with '%(asctime)s %(levelname)s %(filename)s:%(lineno)s %(message)s')
+so operators migrating from the CFN stack see familiar logs.  Credentials are
+scrubbed before logging, as the reference did for IAM role info
+(dl_cfn_setup_v2.py:370-373).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import sys
+
+_FORMAT = "%(asctime)s %(levelname)s %(filename)s:%(lineno)s %(message)s"
+
+_SECRET_RE = re.compile(
+    r"(token|secret|password|credential|authorization)[\"']?\s*[:=]\s*[\"']?([^\s\"',}]+)",
+    re.IGNORECASE,
+)
+
+
+def scrub(text: str) -> str:
+    """Redact credential-looking values from a string before logging."""
+    return _SECRET_RE.sub(lambda m: f"{m.group(1)}=<redacted>", text)
+
+
+class _ScrubFilter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        # Scrub the fully rendered message, not just the format string —
+        # secrets usually arrive via %-args (e.g. a cloud error detail
+        # echoing request context).
+        try:
+            rendered = record.getMessage()
+        except Exception:
+            return True
+        scrubbed = scrub(rendered)
+        if scrubbed != rendered:
+            record.msg = scrubbed
+            record.args = ()
+        return True
+
+
+_configured: set[str] = set()
+
+
+def get_logger(name: str = "dlcfn", log_file: str | None = None) -> logging.Logger:
+    """Return a logger writing `time level file:line msg` lines.
+
+    If ``log_file`` (or $DLCFN_LOG_FILE) is set, logs are duplicated there,
+    mirroring the reference's dual console + /var/log/dl_cfn_setup.log sink.
+    """
+    logger = logging.getLogger(name)
+    if name in _configured:
+        return logger
+    _configured.add(name)
+    logger.setLevel(os.environ.get("DLCFN_LOG_LEVEL", "INFO").upper())
+    logger.propagate = False
+    fmt = logging.Formatter(_FORMAT)
+    stream = logging.StreamHandler(sys.stderr)
+    stream.setFormatter(fmt)
+    stream.addFilter(_ScrubFilter())
+    logger.addHandler(stream)
+    log_file = log_file or os.environ.get("DLCFN_LOG_FILE")
+    if log_file:
+        fileh = logging.FileHandler(log_file)
+        fileh.setFormatter(fmt)
+        fileh.addFilter(_ScrubFilter())
+        logger.addHandler(fileh)
+    return logger

@@ -1,0 +1,227 @@
+"""Discovery/elasticity choreography tests — the reference's trickiest logic
+(SURVEY §7 hard part #1), finally under test: duplicate messages, partial
+capacity, degrade-and-continue, timeout budgets, membership freezing,
+storage retention.
+"""
+
+import pytest
+
+from deeplearning_cfn_tpu.cluster.bootstrap import CLUSTER_READY_RESOURCE
+from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+from deeplearning_cfn_tpu.config.schema import ClusterSpec, JobSpec, NodePool, StorageSpec, TimeoutSpec
+from deeplearning_cfn_tpu.provision.backend import ResourceSignal
+from deeplearning_cfn_tpu.provision.local import LocalBackend
+from deeplearning_cfn_tpu.provision.provisioner import (
+    ProvisionFailure,
+    Provisioner,
+    worker_group_name,
+)
+from deeplearning_cfn_tpu.utils.timeouts import FakeClock
+
+GROUP = worker_group_name("test-cluster")
+
+
+def make_spec(workers=4, min_workers=None, batch=None):
+    batch = batch if batch is not None else workers * 8
+    return ClusterSpec(
+        name="test-cluster",
+        backend="local",
+        pool=NodePool(accelerator_type="local-1", workers=workers, min_workers=min_workers),
+        storage=StorageSpec(kind="local"),
+        timeouts=TimeoutSpec(cluster_ready_s=3300.0, controller_launch_s=600.0),
+        job=JobSpec(global_batch_size=batch),
+    )
+
+
+def test_happy_path_full_capacity(contract_root):
+    backend = LocalBackend(clock=FakeClock())
+    prov = Provisioner(backend, make_spec(workers=4), contract_root=contract_root)
+    result = prov.provision()
+    assert not result.degraded
+    assert result.contract.workers_count == 4
+    # Coordinator is worker 0 and heads the sorted list (dl_cfn_setup_v2.py:330-342).
+    assert result.contract.worker_ips[0] == result.contract.coordinator_ip
+    assert result.contract.worker_ips[1:] == sorted(result.contract.worker_ips[1:])
+    # Membership frozen after the hostfile is cut (lambda_function.py:129-132).
+    assert backend.describe_group(GROUP).replace_unhealthy_suspended
+    assert backend.get_resource_signal(CLUSTER_READY_RESOURCE) is ResourceSignal.SUCCESS
+
+
+def test_contract_files_published(contract_root):
+    backend = LocalBackend(clock=FakeClock())
+    result = Provisioner(
+        backend, make_spec(workers=3, batch=33), contract_root=contract_root
+    ).provision()
+    workers_file = (contract_root / "workers").read_text().splitlines()
+    assert workers_file == ["deeplearning-master", "deeplearning-worker1", "deeplearning-worker2"]
+    hosts = (contract_root / "hosts").read_text()
+    assert "deeplearning-master" in hosts
+    env = (contract_root / "env.sh").read_text()
+    assert "export DEEPLEARNING_WORKERS_COUNT=3" in env
+    assert "export DEEPLEARNING_COORDINATOR=" in env
+    roundtrip = ClusterContract.read(contract_root)
+    assert roundtrip == result.contract
+
+
+def test_duplicate_events_are_deduped(contract_root):
+    # SNS/SQS at-least-once: every lifecycle event delivered twice; the
+    # coordinator must dedup group-setup by group name (dl_cfn_setup_v2.py:142-149).
+    backend = LocalBackend(clock=FakeClock(), duplicate_events=True)
+    result = Provisioner(backend, make_spec(workers=4), contract_root=contract_root).provision()
+    assert result.contract.workers_count == 4
+    # All group-setup duplicates were consumed and deleted.
+    coord_q = backend.get_queue("test-cluster-coordinator-queue")
+    assert coord_q.approximate_depth() == 0
+
+
+def test_degrade_and_continue_partial_capacity(contract_root):
+    # 2 of 6 instances fail; min_workers=3 => shrink to 4 and proceed
+    # (lambda_function.py:142-169, README.md:49).
+    backend = LocalBackend(
+        clock=FakeClock(), fail_instance_indices={GROUP: {1, 4}}
+    )
+    spec = make_spec(workers=6, min_workers=3, batch=24)
+    result = Provisioner(backend, spec, contract_root=contract_root).provision()
+    assert result.degraded
+    assert result.contract.workers_count == 4
+    assert result.realized_workers == 4
+    group = backend.describe_group(GROUP)
+    assert group.desired == 4  # set_desired_capacity shrunk it
+    assert group.replace_unhealthy_suspended
+
+
+def test_below_minimum_fails_provisioning(contract_root):
+    # 3 of 4 fail; min_workers=2 cannot be met => FAILURE signal, rollback.
+    backend = LocalBackend(
+        clock=FakeClock(), fail_instance_indices={GROUP: {0, 1, 2}}
+    )
+    spec = make_spec(workers=4, min_workers=2, batch=4)
+    with pytest.raises(ProvisionFailure):
+        Provisioner(backend, spec, contract_root=contract_root).provision()
+    assert (
+        backend.get_resource_signal(f"group:{GROUP}") is ResourceSignal.FAILURE
+    )
+
+
+def test_slow_launch_within_budget(contract_root):
+    # Instances stay PENDING for 300 simulated seconds; the coordinator's
+    # wait_until_instances_active poll loop (30 s cadence) must ride it out.
+    clock = FakeClock()
+    backend = LocalBackend(clock=clock, launch_delay_s=300.0)
+    result = Provisioner(backend, make_spec(workers=2), contract_root=contract_root).provision()
+    assert result.contract.workers_count == 2
+    assert clock.now() >= 300.0  # really waited (in fake time)
+
+
+def test_timeout_budget_exhaustion(contract_root):
+    # Launch delay exceeds the whole bootstrap budget => typed phase failure,
+    # the analog of the WaitCondition timeout rollback (deeplearning.template:769-780).
+    clock = FakeClock()
+    backend = LocalBackend(clock=clock, launch_delay_s=10_000.0)
+    spec = make_spec(workers=2)
+    with pytest.raises(ProvisionFailure, match="instances-active"):
+        Provisioner(backend, spec, contract_root=contract_root).provision()
+
+
+def test_storage_create_or_reuse(contract_root):
+    backend = LocalBackend(clock=FakeClock())
+    r1 = Provisioner(backend, make_spec(workers=2), contract_root=contract_root).provision()
+    sid = r1.storage.storage_id
+    assert r1.storage.created
+    # Second cluster reusing the same storage id (EFSFileSystemId analog).
+    spec2 = make_spec(workers=2)
+    spec2.name = "second"
+    spec2.storage.existing_id = sid
+    r2 = Provisioner(backend, spec2, contract_root=contract_root).provision()
+    assert r2.storage.storage_id == sid
+    assert not r2.storage.created
+
+
+def test_storage_retained_on_delete(contract_root):
+    # DeletionPolicy: Retain (deeplearning.template:456): checkpoints survive.
+    backend = LocalBackend(clock=FakeClock())
+    prov = Provisioner(backend, make_spec(workers=2), contract_root=contract_root)
+    result = prov.provision()
+    out = prov.delete()
+    assert out["storage_deleted"] is False
+    assert backend.storage_exists(result.storage.storage_id)
+    # force=True overrides retention
+    assert backend.delete_storage(result.storage.storage_id, force=True)
+
+
+def test_terminate_after_ready_records_loss(contract_root):
+    backend = LocalBackend(clock=FakeClock())
+    prov = Provisioner(backend, make_spec(workers=3), contract_root=contract_root)
+    result = prov.provision()
+    victim = backend.describe_group(GROUP).instances[1]
+    backend.kill_instance(victim.instance_id)
+    assert victim.instance_id in result.controller.lost_instances
+
+
+def test_describe_reports_realized_state(contract_root):
+    backend = LocalBackend(
+        clock=FakeClock(), fail_instance_indices={GROUP: {5}}
+    )
+    prov = Provisioner(
+        backend, make_spec(workers=6, min_workers=3, batch=30), contract_root=contract_root
+    )
+    prov.provision()
+    desc = prov.describe()
+    assert desc["ready"] is True
+    assert desc["workers"]["desired"] == 5
+    assert desc["workers"]["frozen"] is True
+
+
+def test_jax_initialize_kwargs_contract(contract_root):
+    backend = LocalBackend(clock=FakeClock())
+    result = Provisioner(backend, make_spec(workers=4), contract_root=contract_root).provision()
+    kw = result.contract.jax_initialize_kwargs(process_id=2)
+    assert kw["num_processes"] == 4
+    assert kw["process_id"] == 2
+    assert kw["coordinator_address"].startswith(result.contract.coordinator_ip)
+
+
+def test_worker_queue_stray_message_does_not_shadow_broadcast(contract_root):
+    # A stray message at the head of the worker queue must not livelock
+    # workers polling with visibility_timeout=0 (code-review regression).
+    backend = LocalBackend(clock=FakeClock())
+    # Pre-seed the worker queue with junk before provisioning.
+    q = backend.create_queue("test-cluster-worker-queue")
+    q.send({"event": "bogus"})
+    result = Provisioner(backend, make_spec(workers=3, batch=33), contract_root=contract_root).provision()
+    assert result.contract.workers_count == 3
+    # Junk consumed; broadcast retained for late joiners.
+    remaining = q.receive(max_messages=10, visibility_timeout_s=0)
+    assert [m.body["event"] for m in remaining] == ["worker-setup"]
+
+
+def test_below_minimum_fails_fast_not_by_timeout(contract_root):
+    # The FAILURE resource signal must short-circuit the coordinator wait —
+    # no burning the full 2700 s budget (code-review regression).
+    clock = FakeClock()
+    backend = LocalBackend(clock=clock, fail_instance_indices={GROUP: {0, 1, 2}})
+    spec = make_spec(workers=4, min_workers=2, batch=4)
+    with pytest.raises(ProvisionFailure, match="minimum capacity"):
+        Provisioner(backend, spec, contract_root=contract_root).provision()
+    assert clock.now() < 60.0  # failed fast, not via budget exhaustion
+
+
+def test_degraded_cluster_surfaces_job_violation(contract_root):
+    # Shrinking can break batch divisibility the original spec satisfied.
+    backend = LocalBackend(clock=FakeClock(), fail_instance_indices={GROUP: {5}})
+    spec = make_spec(workers=6, min_workers=5, batch=48)  # 48 % 6 == 0, 48 % 5 != 0
+    result = Provisioner(backend, spec, contract_root=contract_root).provision()
+    assert result.degraded
+    assert result.job_violation is not None
+    assert "not divisible" in result.job_violation
+
+
+def test_env_sh_paths_point_at_published_root(contract_root):
+    # DEEPLEARNING_WORKERS_PATH must reference the root actually written,
+    # independent of $DLCFN_ROOT (code-review regression).
+    backend = LocalBackend(clock=FakeClock())
+    explicit_root = contract_root.parent / "elsewhere"
+    Provisioner(backend, make_spec(workers=2), contract_root=explicit_root).provision()
+    env = (explicit_root / "env.sh").read_text()
+    assert f"DEEPLEARNING_WORKERS_PATH={explicit_root}/workers" in env
+    assert (explicit_root / "workers").exists()

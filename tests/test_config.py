@@ -1,0 +1,156 @@
+"""Config schema + template tests.
+
+Covers the validation surface the reference expressed as CloudFormation
+Parameters/AllowedValues/Conditions (deeplearning.template:4-178) and the
+launcher invariants (run.sh:43-44, run.sh:56-66).
+"""
+
+import pytest
+
+from deeplearning_cfn_tpu.config.schema import (
+    ClusterSpec,
+    ConfigError,
+    JobSpec,
+    NodePool,
+    StorageSpec,
+    TimeoutSpec,
+)
+from deeplearning_cfn_tpu.config.template import render_template, resolve_parameters
+
+
+def test_default_spec_validates():
+    spec = ClusterSpec()
+    assert spec.validate() is spec
+    assert spec.pool.num_workers == 4  # v5p-32 => 16 chips / 4 per VM
+    assert spec.pool.total_chips == 16
+
+
+def test_bad_accelerator_type_rejected():
+    with pytest.raises(ConfigError, match="accelerator_type"):
+        ClusterSpec(pool=NodePool(accelerator_type="p3.16xlarge")).validate()
+
+
+def test_bad_cluster_name_rejected():
+    with pytest.raises(ConfigError, match="cluster name"):
+        ClusterSpec(name="Bad Name!").validate()
+
+
+def test_gcp_backend_requires_project_zone():
+    with pytest.raises(ConfigError, match="project and zone"):
+        ClusterSpec(backend="gcp").validate()
+
+
+def test_min_workers_bounds():
+    with pytest.raises(ConfigError, match="min_workers"):
+        ClusterSpec(pool=NodePool(accelerator_type="local-4", min_workers=9)).validate()
+
+
+def test_batch_divisibility_invariant():
+    # global batch must divide across chips (the linear-scaling contract)
+    with pytest.raises(ConfigError, match="not divisible"):
+        ClusterSpec(
+            pool=NodePool(accelerator_type="local-8"),
+            job=JobSpec(global_batch_size=100),
+        ).validate()
+
+
+def test_even_worker_invariant():
+    # run.sh:43-44: worker count must be 1 or even
+    spec = ClusterSpec(
+        pool=NodePool(accelerator_type="local-1", workers=3),
+        job=JobSpec(require_even_workers=True, global_batch_size=3),
+    )
+    with pytest.raises(ConfigError, match="1 or even"):
+        spec.validate()
+
+
+def test_steps_per_epoch_linear_scaling():
+    # STEPS_PER_EPOCH = 120000 / (workers * chips)  (run.sh:56,66)
+    pool = NodePool(accelerator_type="v5p-32")
+    job = JobSpec(steps_per_epoch_numerator=120000, global_batch_size=256)
+    assert job.steps_per_epoch(pool) == 120000 // 16
+
+
+def test_roundtrip_serialization():
+    spec = ClusterSpec(
+        name="trip",
+        pool=NodePool(accelerator_type="local-8", min_workers=4),
+        storage=StorageSpec(kind="local", mount_point="/mnt/x"),
+        timeouts=TimeoutSpec(cluster_ready_s=100.0, controller_launch_s=10.0),
+        job=JobSpec(global_batch_size=64),
+    ).validate()
+    again = ClusterSpec.from_dict(spec.to_dict())
+    assert again == spec
+
+
+TEMPLATE = {
+    "Parameters": {
+        "WorkerType": {
+            "type": "str",
+            "default": "local-8",
+            "allowed": ["local-8", "v5p-32"],
+        },
+        "MinWorkers": {"type": "int", "default": 4, "min": 1},
+        "StorageId": {"type": "str", "default": ""},
+        "Zone": {"type": "str", "default": "us-central2-b"},
+    },
+    "Mappings": {
+        "ZoneDefaults": {
+            "us-central2-b": {"runtime": "tpu-ubuntu2204-base"},
+            "europe-west4-b": {"runtime": "tpu-vm-v4-base"},
+        }
+    },
+    "Conditions": {
+        "CreateStorage": {"equals": [{"ref": "StorageId"}, ""]},
+    },
+    "Cluster": {
+        "name": "templated",
+        "backend": "local",
+        "pool": {
+            "accelerator_type": {"ref": "WorkerType"},
+            "min_workers": {"ref": "MinWorkers"},
+            "runtime_version": {
+                "find_in_map": ["ZoneDefaults", {"ref": "Zone"}, "runtime"]
+            },
+        },
+        "storage": {
+            "kind": "local",
+            "existing_id": {"if": ["CreateStorage", None, {"ref": "StorageId"}]},
+        },
+        "job": {"global_batch_size": 64},
+    },
+}
+
+
+def test_template_render_defaults():
+    spec = render_template(TEMPLATE)
+    assert spec.pool.accelerator_type == "local-8"
+    assert spec.pool.min_workers == 4
+    assert spec.pool.runtime_version == "tpu-ubuntu2204-base"
+    assert spec.storage.existing_id is None  # CreateStorage condition true
+
+
+def test_template_render_with_overrides():
+    spec = render_template(
+        TEMPLATE,
+        {"WorkerType": "v5p-32", "StorageId": "fs-0001", "Zone": "europe-west4-b"},
+    )
+    assert spec.pool.accelerator_type == "v5p-32"
+    assert spec.storage.existing_id == "fs-0001"  # reuse branch taken
+    assert spec.pool.runtime_version == "tpu-vm-v4-base"
+
+
+def test_template_rejects_disallowed_value():
+    with pytest.raises(ConfigError, match="not in allowed values"):
+        render_template(TEMPLATE, {"WorkerType": "v6e-256"})
+
+
+def test_template_rejects_unknown_parameter():
+    with pytest.raises(ConfigError, match="unknown parameters"):
+        render_template(TEMPLATE, {"Nope": 1})
+
+
+def test_required_parameter_missing():
+    tmpl = {"Parameters": {"Req": {"type": "int"}}, "Cluster": {"name": "x"}}
+    with pytest.raises(ConfigError, match="required"):
+        resolve_parameters(tmpl, {})

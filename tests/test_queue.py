@@ -1,0 +1,86 @@
+"""Rendezvous queue semantics tests.
+
+The three SQS behaviors the reference's choreography depends on
+(SURVEY §2.4): visibility timeout, at-least-once duplication, and the
+broadcast-without-delete trick (dl_cfn_setup_v2.py:180-190).
+"""
+
+from deeplearning_cfn_tpu.cluster.queue import InMemoryQueue
+from deeplearning_cfn_tpu.utils.timeouts import FakeClock
+
+
+def test_send_receive_delete():
+    q = InMemoryQueue("q", clock=FakeClock())
+    q.send({"a": 1})
+    msgs = q.receive(max_messages=10, visibility_timeout_s=60)
+    assert len(msgs) == 1 and msgs[0].body == {"a": 1}
+    q.delete(msgs[0].receipt)
+    assert q.approximate_depth() == 0
+
+
+def test_visibility_timeout_hides_then_redelivers():
+    clock = FakeClock()
+    q = InMemoryQueue("q", clock=clock)
+    q.send({"a": 1})
+    first = q.receive(visibility_timeout_s=60)
+    assert len(first) == 1
+    # Invisible while the timeout holds...
+    assert q.receive(visibility_timeout_s=60) == []
+    # ...redelivered after it lapses without a delete.
+    clock.advance(61)
+    again = q.receive(visibility_timeout_s=60)
+    assert len(again) == 1
+    assert again[0].receive_count == 2
+
+
+def test_broadcast_trick_zero_visibility_never_delete():
+    # One message read by many consumers: visibility_timeout=0, no delete.
+    q = InMemoryQueue("worker-queue", clock=FakeClock())
+    q.send({"event": "worker-setup", "worker-ips": ["10.0.0.2"]})
+    readers = [q.receive(max_messages=1, visibility_timeout_s=0) for _ in range(16)]
+    assert all(len(r) == 1 for r in readers)
+    assert all(r[0].body["event"] == "worker-setup" for r in readers)
+    assert q.approximate_depth() == 1  # still there for late joiners
+
+
+def test_at_least_once_duplication():
+    q = InMemoryQueue("q", clock=FakeClock())
+    q.duplicate_next_send = True
+    q.send({"event": "group-setup", "group": "workers"})
+    msgs = q.receive(max_messages=10, visibility_timeout_s=0)
+    assert len(msgs) == 2  # consumer must dedup
+
+
+def test_fifo_order_and_batch_limit():
+    q = InMemoryQueue("q", clock=FakeClock())
+    for i in range(15):
+        q.send({"i": i})
+    batch = q.receive(max_messages=10, visibility_timeout_s=60)
+    assert [m.body["i"] for m in batch] == list(range(10))
+
+
+def test_delete_unknown_receipt_is_noop():
+    q = InMemoryQueue("q", clock=FakeClock())
+    q.send({"a": 1})
+    q.delete("bogus-receipt")
+    assert q.approximate_depth() == 1
+
+
+def test_logging_scrubs_rendered_args(capsys):
+    # Secrets arriving via %-args must be redacted too (code-review regression).
+    import logging as _logging
+
+    from deeplearning_cfn_tpu.utils.logging import get_logger
+
+    log = get_logger("dlcfn.test-scrub")
+    stream_records = []
+
+    class Grab(_logging.Handler):
+        def emit(self, record):
+            stream_records.append(self.format(record))
+
+    h = Grab()
+    h.setFormatter(_logging.Formatter("%(message)s"))
+    log.addHandler(h)
+    log.warning("cloud error: %s", "request failed token=sk-supersecret123")
+    assert any("redacted" in r and "supersecret" not in r for r in stream_records)
