@@ -1,0 +1,93 @@
+"""Headline benchmark: ResNet-50 synthetic ImageNet throughput per chip.
+
+BASELINE.json's driver metric is "ResNet-50 ImageNet images/sec/chip".  The
+reference's corresponding workload is the Horovod synthetic ResNet-50
+benchmark (README.md:149-163), for which it publishes **no number**
+(BASELINE.md).  ``vs_baseline`` is therefore computed against the era's
+publicly documented tensorpack+Horovod ResNet-50 throughput on the
+reference's own hardware class (~350 images/sec per V100 on p3.16xlarge,
+fp16, batch 64/GPU) — the workload the reference stack existed to run.
+
+Runs on whatever accelerator JAX exposes (the driver provides one real TPU
+chip).  Prints exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Per-GPU throughput of the reference's flagship stack on its own hardware
+# (tensorpack ResNet-50 + Horovod on V100, the workload of README.md:149-163).
+REFERENCE_IMAGES_PER_SEC_PER_DEVICE = 350.0
+
+BATCH_PER_CHIP = 128
+IMAGE_SIZE = 224
+WARMUP_STEPS = 5
+MEASURE_STEPS = 20
+
+
+def main() -> None:
+    from deeplearning_cfn_tpu.models.resnet import ResNet50
+    from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
+    from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    batch = BATCH_PER_CHIP * n_chips
+
+    mesh = build_mesh(MeshSpec.data_parallel(n_chips), devices)
+    model = ResNet50(dtype=jnp.bfloat16)
+    trainer = Trainer(
+        model,
+        mesh,
+        TrainerConfig(
+            strategy="dp",
+            learning_rate=0.1,
+            has_train_arg=True,
+            label_smoothing=0.1,
+        ),
+    )
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((batch, IMAGE_SIZE, IMAGE_SIZE, 3))
+    y = rng.integers(0, 1000, size=batch).astype(np.int32)
+    # bf16 inputs: halves the host->device bytes and matches compute dtype.
+    x = jax.device_put(jnp.asarray(x, jnp.bfloat16), trainer.batch_sharding)
+    y = jax.device_put(jnp.asarray(y), trainer.batch_sharding)
+
+    state = trainer.init(jax.random.key(0), x)
+    step = trainer.step_fn
+    for _ in range(WARMUP_STEPS):
+        state, metrics = step(state, x, y)
+    # float() forces a device->host readback through the whole step chain —
+    # block_until_ready alone proved unreliable on relayed PJRT backends.
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, metrics = step(state, x, y)
+    final_loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
+
+    images_per_sec = batch * MEASURE_STEPS / dt
+    per_chip = images_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_synthetic_images_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC_PER_DEVICE, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -43,7 +43,10 @@ from deeplearning_cfn_tpu.utils.timeouts import TimeoutBudget
 
 log = get_logger("dlcfn.bootstrap")
 
-CLUSTER_READY_RESOURCE = "cluster-wait-condition"
+def cluster_ready_resource(cluster_name: str) -> str:
+    """Per-cluster WaitCondition resource name — namespaced so clusters
+    sharing a backend cannot read each other's ready/failure signals."""
+    return f"cluster-ready:{cluster_name}"
 
 
 class BootstrapError(RuntimeError):
@@ -70,6 +73,9 @@ class BootstrapAgent:
     poll_interval_s: float = 30.0
     storage_mount: str = "/mnt/dlcfn"
     contract_root: Path | None = None
+    # group -> signal-resource name; must match GroupPolicy.signal_resource
+    # registered with the controller (provisioner wires both sides).
+    group_signal_resources: dict[str, str] | None = None
     credential_probe: Callable[[], bool] = lambda: True
     # SQS batch size from the reference (dl_cfn_setup_v2.py:36-37,139-141)
     receive_batch: int = 10
@@ -94,9 +100,12 @@ class BootstrapAgent:
             # (below-minimum capacity) — the definitive signal is on the
             # group resource; waiting out the whole budget would burn ~45
             # real minutes for an answer that is already known.
+            signal_names = self.group_signal_resources or {}
             for name in pending:
                 if (
-                    self.backend.get_resource_signal(f"group:{name}")
+                    self.backend.get_resource_signal(
+                        signal_names.get(name, f"group:{name}")
+                    )
                     is ResourceSignal.FAILURE
                 ):
                     raise BootstrapError(
@@ -195,7 +204,9 @@ class BootstrapAgent:
         )
         self._publish_contract(contract)
         self.worker_queue.send(contract.to_message())
-        self.backend.signal_resource(CLUSTER_READY_RESOURCE, ResourceSignal.SUCCESS)
+        self.backend.signal_resource(
+            cluster_ready_resource(self.cluster_name), ResourceSignal.SUCCESS
+        )
         log.info(
             "cluster %s ready: %d workers x %d chips%s",
             self.cluster_name,

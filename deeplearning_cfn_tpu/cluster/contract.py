@@ -151,6 +151,8 @@ class ClusterContract:
             "storage-mount": self.storage_mount,
             "degraded": self.degraded,
             "cluster": self.cluster_name,
+            "coordinator-port": self.coordinator_port,
+            "tags": self.tags,
         }
 
     @classmethod
@@ -162,4 +164,6 @@ class ClusterContract:
             chips_per_worker=int(body["chips-per-worker"]),  # type: ignore[arg-type]
             storage_mount=str(body["storage-mount"]),
             degraded=bool(body.get("degraded", False)),
+            coordinator_port=int(body.get("coordinator-port", DEFAULT_COORDINATOR_PORT)),  # type: ignore[arg-type]
+            tags=dict(body.get("tags", {})),  # type: ignore[arg-type]
         )

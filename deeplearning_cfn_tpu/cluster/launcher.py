@@ -1,0 +1,130 @@
+"""Job launcher — from a ready cluster to a running SPMD training job.
+
+Replaces the reference's two launch paths (SURVEY §3.5): the Horovod/mpirun
+path (run.sh builds a hostfile, SSH-warms every node, computes
+NUM_PARALLEL = workers x gpus, then execs ``mpirun -np`` with transport
+tuning, run.sh:46-95) and the TF-PS path (generate_trainer.py writing
+per-host scripts with ps/worker topology, generate_trainer.py:19-76).
+
+TPU-native, both collapse into one shape: **every worker runs the same
+program**.  The launcher's job is therefore (a) enforcing invariants up
+front exactly where run.sh:43-44 did, (b) rendering the per-worker launch
+plan (command + env derived from the cluster contract — no SSH fan-out,
+workers pick it up from their metadata/startup script), and (c) for the
+local backend, executing the program in-process over a virtual mesh.
+"""
+
+from __future__ import annotations
+
+import shlex
+from dataclasses import dataclass, field
+
+from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+from deeplearning_cfn_tpu.config.schema import JobSpec
+from deeplearning_cfn_tpu.utils.logging import get_logger
+
+log = get_logger("dlcfn.launch")
+
+
+class LaunchError(RuntimeError):
+    pass
+
+
+@dataclass
+class WorkerLaunch:
+    process_id: int
+    host: str
+    command: str
+    env: dict[str, str]
+
+
+@dataclass
+class LaunchPlan:
+    job_name: str
+    workers: list[WorkerLaunch]
+    num_parallel: int  # workers x chips — NUM_PARALLEL (run.sh:56)
+    steps_per_epoch: int | None
+
+    def render_script(self, process_id: int) -> str:
+        """A per-worker launch script — the {host}.sh analog
+        (generate_trainer.py:64-76), env-driven instead of SSH-pushed."""
+        w = self.workers[process_id]
+        lines = ["#!/bin/bash", "set -euo pipefail"]
+        lines += [f"export {k}={shlex.quote(v)}" for k, v in sorted(w.env.items())]
+        lines.append(w.command)
+        return "\n".join(lines) + "\n"
+
+
+def build_launch_plan(
+    contract: ClusterContract,
+    job: JobSpec,
+    job_violation: str | None = None,
+) -> LaunchPlan:
+    """Validate invariants and render the all-workers launch plan."""
+    # Invariants checked just before launch, as run.sh:43-44 checked the
+    # worker count right before mpirun.
+    if job_violation:
+        raise LaunchError(
+            f"job invalid on the realized cluster: {job_violation}. "
+            "Adjust global_batch_size or recreate the cluster at full size."
+        )
+    n = contract.workers_count
+    if job.require_even_workers and n != 1 and n % 2:
+        raise LaunchError(f"worker count must be 1 or even, got {n}")
+    if job.global_batch_size % contract.total_chips:
+        raise LaunchError(
+            f"global_batch_size {job.global_batch_size} not divisible by "
+            f"{contract.total_chips} chips"
+        )
+
+    num_parallel = contract.total_chips
+    steps = (
+        max(1, job.steps_per_epoch_numerator // num_parallel)
+        if job.steps_per_epoch_numerator
+        else None
+    )
+
+    args = " ".join(
+        f"--{k} {shlex.quote(str(v))}" for k, v in sorted(job.args.items())
+    )
+    workers = []
+    for pid, host in enumerate(contract.hostnames()):
+        env = dict(contract.env())
+        env["DLCFN_PROCESS_ID"] = str(pid)
+        env["DLCFN_JOB_NAME"] = job.name
+        workers.append(
+            WorkerLaunch(
+                process_id=pid,
+                host=host,
+                command=f"python -m {job.module} {args}".strip(),
+                env=env,
+            )
+        )
+    plan = LaunchPlan(
+        job_name=job.name,
+        workers=workers,
+        num_parallel=num_parallel,
+        steps_per_epoch=steps,
+    )
+    log.info(
+        "launch plan %s: %d workers, NUM_PARALLEL=%d, steps/epoch=%s",
+        job.name,
+        n,
+        num_parallel,
+        steps,
+    )
+    return plan
+
+
+@dataclass
+class LocalJobRunner:
+    """Executes a launch plan in-process over the virtual device mesh —
+    the local backend's stand-in for every TPU VM running its copy."""
+
+    plan: LaunchPlan
+    results: list = field(default_factory=list)
+
+    def run(self, entrypoint, *args, **kwargs):
+        """Run the job's entrypoint once (single-controller semantics:
+        the virtual mesh spans all 'workers')."""
+        return entrypoint(*args, **kwargs)

@@ -1,0 +1,8 @@
+"""Runnable training entry points — the analog of the reference's
+examples/ tree (SURVEY §2.1 C4-C6, C12), launched via the cluster contract.
+
+Every example follows the TPU launch model: all workers run the same module;
+process identity and rendezvous come from the DEEPLEARNING_* env contract
+(``deeplearning_cfn_tpu.examples.common.maybe_init_distributed``), not from
+mpirun or per-host generated scripts.
+"""

@@ -1,0 +1,47 @@
+"""Shared plumbing for example trainers."""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax
+
+from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
+from deeplearning_cfn_tpu.utils.logging import get_logger
+
+log = get_logger("dlcfn.examples")
+
+
+def maybe_init_distributed() -> int:
+    """Join the jax.distributed cluster if the contract says we're one of
+    many processes.  Replaces MPI rendezvous (run.sh:72-77): the coordinator
+    address and process id come from the env contract the discovery agent
+    published (contract.py), not from a hostfile.
+    Returns this process's id."""
+    n = int(os.environ.get("DEEPLEARNING_WORKERS_COUNT", "1"))
+    pid = int(os.environ.get("DLCFN_PROCESS_ID", "0"))
+    coordinator = os.environ.get("DEEPLEARNING_COORDINATOR")
+    if n > 1 and coordinator:
+        jax.distributed.initialize(
+            coordinator_address=coordinator, num_processes=n, process_id=pid
+        )
+        log.info("joined jax.distributed: process %d/%d via %s", pid, n, coordinator)
+    return pid
+
+
+def default_mesh(strategy: str = "dp"):
+    n = len(jax.devices())
+    spec = MeshSpec.fsdp_parallel(n) if strategy == "fsdp" else MeshSpec.data_parallel(n)
+    return build_mesh(spec)
+
+
+def base_parser(description: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--global_batch_size", type=int, default=None)
+    p.add_argument("--learning_rate", type=float, default=None)
+    p.add_argument("--log_every", type=int, default=10)
+    p.add_argument("--strategy", choices=["dp", "fsdp"], default="dp")
+    p.add_argument("--checkpoint_dir", default=os.environ.get("DLCFN_CHECKPOINT_DIR"))
+    return p

@@ -1,0 +1,49 @@
+"""Distributed LeNet/MNIST — the first-training-run walkthrough.
+
+Analog of the reference's MXNet image-classification walkthrough
+(README.md:112-143), which launched LeNet-class training across the cluster
+via launch.py + the DEEPLEARNING_* contract.  Here the same contract feeds
+``maybe_init_distributed`` and the model runs as one SPMD program.
+
+Run: ``python -m deeplearning_cfn_tpu.examples.lenet_mnist --steps 100``
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning_cfn_tpu.examples.common import base_parser, default_mesh, maybe_init_distributed
+from deeplearning_cfn_tpu.models.lenet import LeNet
+from deeplearning_cfn_tpu.train.data import SyntheticDataset
+from deeplearning_cfn_tpu.train.metrics import ThroughputLogger
+from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
+
+
+def main(argv: list[str] | None = None) -> dict:
+    args = base_parser(__doc__).parse_args(argv)
+    maybe_init_distributed()
+    batch = args.global_batch_size or 64
+    lr = args.learning_rate or 0.05
+    mesh = default_mesh(args.strategy)
+    trainer = Trainer(
+        LeNet(),
+        mesh,
+        TrainerConfig(
+            strategy=args.strategy,
+            learning_rate=lr,
+            # Small f32 model: pin f32 matmuls or the MXU's default bf16
+            # lowering stalls training at init loss.
+            matmul_precision="float32",
+        ),
+    )
+    ds = SyntheticDataset.mnist_like(batch_size=batch)
+    sample = next(iter(ds.batches(1)))
+    state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
+    logger = ThroughputLogger(global_batch_size=batch, log_every=args.log_every, name="lenet")
+    state, losses = trainer.fit(state, ds.batches(args.steps), steps=args.steps, logger=logger)
+    return {"final_loss": losses[-1], "steps": len(losses)}
+
+
+if __name__ == "__main__":
+    print(main())

@@ -1,0 +1,57 @@
+"""Distributed ResNet ImageNet training — the flagship throughput workload.
+
+Analog of the reference's two heavyweight paths: the Horovod ResNet-50
+synthetic benchmark (README.md:149-163) and the MXNet ResNet-152
+dist_device_sync job (README.md:139).  One SPMD program replaces both; the
+``--depth`` flag selects the family member.
+
+Run: ``python -m deeplearning_cfn_tpu.examples.resnet_imagenet --depth 50 --steps 50``
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning_cfn_tpu.examples.common import base_parser, default_mesh, maybe_init_distributed
+from deeplearning_cfn_tpu.models.resnet import ResNet50, ResNet101, ResNet152
+from deeplearning_cfn_tpu.train.data import SyntheticDataset
+from deeplearning_cfn_tpu.train.metrics import ThroughputLogger
+from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
+
+DEPTHS = {50: ResNet50, 101: ResNet101, 152: ResNet152}
+
+
+def main(argv: list[str] | None = None) -> dict:
+    p = base_parser(__doc__)
+    p.add_argument("--depth", type=int, choices=sorted(DEPTHS), default=50)
+    p.add_argument("--image_size", type=int, default=224)
+    p.add_argument("--bf16", action="store_true", default=True)
+    args = p.parse_args(argv)
+    maybe_init_distributed()
+    batch = args.global_batch_size or 32 * len(jax.devices())
+    lr = args.learning_rate or 0.1
+    mesh = default_mesh(args.strategy)
+    model = DEPTHS[args.depth](dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+    trainer = Trainer(
+        model,
+        mesh,
+        TrainerConfig(
+            strategy=args.strategy,
+            learning_rate=lr,
+            has_train_arg=True,
+            label_smoothing=0.1,
+        ),
+    )
+    ds = SyntheticDataset.imagenet_like(batch_size=batch, image_size=args.image_size)
+    sample = next(iter(ds.batches(1)))
+    state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
+    logger = ThroughputLogger(
+        global_batch_size=batch, log_every=args.log_every, name=f"resnet{args.depth}"
+    )
+    state, losses = trainer.fit(state, ds.batches(args.steps), steps=args.steps, logger=logger)
+    return {"final_loss": losses[-1], "steps": len(losses), "history": logger.history}
+
+
+if __name__ == "__main__":
+    print(main())

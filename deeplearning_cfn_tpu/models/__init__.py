@@ -1,0 +1,1 @@
+from deeplearning_cfn_tpu.models.lenet import LeNet  # noqa: F401

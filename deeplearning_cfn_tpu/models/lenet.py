@@ -1,0 +1,32 @@
+"""LeNet-5-class CNN for MNIST — the framework's hello-world model.
+
+Capability analog of the reference's first training walkthrough: MXNet
+LeNet/MNIST driven through the cluster contract (README.md:112-126, which
+runs the incubator-mxnet image-classification example on MNIST/CIFAR).
+Rebuilt as Flax so the same model runs single-chip or data-parallel over a
+mesh with no code change.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LeNet(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        # x: [batch, 28, 28, 1]
+        x = nn.Conv(32, (5, 5), padding="SAME", name="conv1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(512, name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, name="fc2")(x)
+        return x

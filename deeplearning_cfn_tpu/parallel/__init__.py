@@ -1,0 +1,6 @@
+from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh  # noqa: F401
+from deeplearning_cfn_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    infer_param_sharding,
+    replicated,
+)

@@ -1,0 +1,138 @@
+"""Device mesh construction — the cluster topology layer.
+
+The reference's topology model is a hostfile: ``deeplearning-worker{i}
+slots=$GPU_COUNT`` consumed by mpirun (run.sh:46-53), with one process per
+GPU and NCCL rings underneath.  The TPU-native equivalent is a named
+:class:`jax.sharding.Mesh`: axes declare *what each dimension of the device
+grid means* (data, fsdp, tensor, sequence, expert parallelism) and XLA lays
+collectives onto ICI automatically — there is no transport configuration to
+tune, which retires the reference's NCCL_MIN_NRINGS / HOROVOD_* knob surface
+(run.sh:70-79).
+
+Axis convention (outermost to innermost — innermost axes get the
+fastest/nearest ICI neighbors, so tensor/sequence axes that communicate most
+go last):
+
+- ``dp``  — pure data parallelism (gradient psum; the Horovod allreduce path)
+- ``fsdp`` — data parallelism with parameter/optimizer sharding (ZeRO-3)
+- ``pp``  — pipeline stages
+- ``sp``  — sequence/context parallelism (ring attention)
+- ``tp``  — tensor (operator) parallelism
+- ``ep``  — expert parallelism (MoE)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("dp", "fsdp", "pp", "sp", "tp", "ep")
+
+
+class MeshError(ValueError):
+    pass
+
+
+@dataclass
+class MeshSpec:
+    """Logical parallelism layout.  Sizes of 1 are kept in the mesh (cheap,
+    and it keeps sharding rules uniform across configs)."""
+
+    dp: int = 1
+    fsdp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+    ep: int = 1
+
+    @property
+    def total(self) -> int:
+        return self.dp * self.fsdp * self.pp * self.sp * self.tp * self.ep
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {name: getattr(self, name) for name in AXIS_ORDER}
+
+    @classmethod
+    def data_parallel(cls, n_devices: int) -> "MeshSpec":
+        return cls(dp=n_devices)
+
+    @classmethod
+    def fsdp_parallel(cls, n_devices: int) -> "MeshSpec":
+        return cls(fsdp=n_devices)
+
+    def validate(self, n_devices: int) -> "MeshSpec":
+        for name, size in self.axis_sizes().items():
+            if size < 1:
+                raise MeshError(f"axis {name} must be >= 1, got {size}")
+        if self.total != n_devices:
+            raise MeshError(
+                f"mesh axes multiply to {self.total} but {n_devices} devices "
+                f"are available ({self.axis_sizes()})"
+            )
+        return self
+
+
+def build_mesh(spec: MeshSpec, devices: list | None = None) -> Mesh:
+    """Arrange devices into a named mesh.
+
+    Device order matters on real hardware: jax.devices() returns devices in
+    torus-friendly order, and reshaping in AXIS_ORDER puts the
+    most-communicative axes (tp/sp, innermost) on nearest ICI neighbors.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    spec.validate(len(devices))
+    grid = np.array(devices).reshape(*(spec.axis_sizes()[a] for a in AXIS_ORDER))
+    return Mesh(grid, AXIS_ORDER)
+
+
+@dataclass
+class AutoLayout:
+    """Heuristic mesh for a model size + chip count, used when the operator
+    does not pin a layout.  Favors FSDP once the model stops fitting
+    replicated, then adds tp for very large models — the standard
+    scaling-book recipe."""
+
+    n_devices: int
+    param_bytes: int = 0
+    hbm_bytes_per_chip: int = 16 << 30
+    max_tp: int = 8
+
+    def choose(self) -> MeshSpec:
+        if self.n_devices == 1:
+            return MeshSpec()
+        # Rough rule: params + grads + adam moments in fp32 master ~ 16x
+        # param_count bytes; if a replica fits in half of HBM, plain DP.
+        if self.param_bytes and self.param_bytes * 16 < self.hbm_bytes_per_chip // 2:
+            return MeshSpec.data_parallel(self.n_devices)
+        if self.param_bytes * 16 < self.hbm_bytes_per_chip * self.n_devices // 2:
+            return MeshSpec.fsdp_parallel(self.n_devices)
+        tp = min(self.max_tp, self.n_devices)
+        # keep tp a power of two dividing n_devices
+        while self.n_devices % tp:
+            tp //= 2
+        tp = max(tp, 1)
+        return MeshSpec(fsdp=self.n_devices // tp, tp=tp)
+
+
+def virtual_cpu_devices(n: int) -> list:
+    """Devices for an n-way virtual mesh on CPU (tests / dry runs).
+
+    Requires XLA_FLAGS=--xla_force_host_platform_device_count=<n> to have
+    been set before JAX initialized (tests/conftest.py does this).
+    """
+    devices = jax.devices()
+    if len(devices) < n:
+        raise MeshError(
+            f"need {n} devices but only {len(devices)} present; set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count and JAX_PLATFORMS=cpu "
+            "before importing jax"
+        )
+    return devices[:n]
+
+
+def largest_pow2_dp(n_devices: int) -> int:
+    return 1 << int(math.log2(max(n_devices, 1)))

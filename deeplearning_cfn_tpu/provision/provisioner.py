@@ -25,9 +25,9 @@ from dataclasses import dataclass
 from pathlib import Path
 
 from deeplearning_cfn_tpu.cluster.bootstrap import (
-    CLUSTER_READY_RESOURCE,
     BootstrapAgent,
     BootstrapError,
+    cluster_ready_resource,
 )
 from deeplearning_cfn_tpu.cluster.contract import ClusterContract
 from deeplearning_cfn_tpu.cluster.elasticity import ElasticityController, GroupPolicy
@@ -177,10 +177,9 @@ class Provisioner:
             else TimeoutBudget(spec.timeouts.bootstrap_budget_s)
         )
         group = self.backend.describe_group(self.group_name)
-        running = [i for i in group.healthy_instances]
-        if not running:
+        candidates = group.healthy_instances  # includes PENDING; IPs resolved below
+        if not candidates:
             raise ProvisionFailure("no healthy instances launched")
-        coordinator_ip = None
         agent = BootstrapAgent(
             backend=self.backend,
             cluster_name=spec.name,
@@ -191,9 +190,10 @@ class Provisioner:
             poll_interval_s=spec.timeouts.poll_interval_s,
             storage_mount=spec.storage.mount_point,
             contract_root=self.contract_root,
+            group_signal_resources={self.group_name: f"group:{self.group_name}"},
         )
         # Worker 0 (lowest index healthy instance) runs the coordinator role.
-        coordinator = min(running, key=lambda i: i.index)
+        coordinator = min(candidates, key=lambda i: i.index)
         coordinator_ip = coordinator.private_ip
         if coordinator_ip is None:
             # It may still be PENDING; the active-wait inside the coordinator
@@ -208,7 +208,9 @@ class Provisioner:
             # The reference's master exits 1 and the WaitCondition times out,
             # rolling the stack back (dl_cfn_setup_v2.py:426-428,
             # deeplearning.template:769-780).
-            self.backend.signal_resource(CLUSTER_READY_RESOURCE, ResourceSignal.FAILURE)
+            self.backend.signal_resource(
+                cluster_ready_resource(spec.name), ResourceSignal.FAILURE
+            )
             raise ProvisionFailure(str(e)) from e
         # Remaining workers consume the broadcast (in a real deployment each
         # runs in its own VM; the local backend runs them inline).
@@ -229,7 +231,7 @@ class Provisioner:
 
     # -- WaitCondition ----------------------------------------------------
     def wait_until_ready(self) -> None:
-        signal = self.backend.get_resource_signal(CLUSTER_READY_RESOURCE)
+        signal = self.backend.get_resource_signal(cluster_ready_resource(self.spec.name))
         if signal is not ResourceSignal.SUCCESS:
             raise ProvisionFailure(
                 f"cluster {self.spec.name!r} did not signal ready "
@@ -247,7 +249,9 @@ class Provisioner:
                 "frozen": group.replace_unhealthy_suspended,
             },
             "storage": self._storage.storage_id if self._storage else None,
-            "ready": self.backend.get_resource_signal(CLUSTER_READY_RESOURCE)
+            "ready": self.backend.get_resource_signal(
+                cluster_ready_resource(self.spec.name)
+            )
             is ResourceSignal.SUCCESS,
         }
 

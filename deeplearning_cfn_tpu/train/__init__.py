@@ -1,0 +1,3 @@
+from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig, TrainState  # noqa: F401
+from deeplearning_cfn_tpu.train.data import SyntheticDataset, probe_data_source  # noqa: F401
+from deeplearning_cfn_tpu.train.metrics import ThroughputLogger  # noqa: F401

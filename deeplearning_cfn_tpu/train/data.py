@@ -1,0 +1,126 @@
+"""Input pipelines.
+
+Two capabilities rebuilt from the reference:
+
+- **Synthetic data** for benchmarking, the analog of the Horovod
+  ``train_synthetic.sh`` path (README.md:149-163): deterministic on-device
+  generation so benchmarks measure compute, not IO.
+- **Data-source probing**: pick the fastest storage that actually has the
+  dataset, like run.sh:21-35 probing FSx -> EFS -> EBS in speed order.
+
+Real dataset loading (MNIST/CIFAR/ImageNet from disk or GCS) goes through
+the same ``Dataset`` protocol so trainers don't care which backs them.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterator
+
+import jax
+import numpy as np
+
+
+def probe_data_source(candidates: list[str | Path], marker: str = "") -> Path | None:
+    """Return the first candidate directory that exists (and contains
+    ``marker`` if given) — speed-ordered probe, run.sh:21-35 style."""
+    for cand in candidates:
+        p = Path(cand)
+        if p.is_dir() and (not marker or (p / marker).exists()):
+            return p
+    return None
+
+
+@dataclass
+class Batch:
+    x: np.ndarray
+    y: np.ndarray
+
+
+@dataclass
+class SyntheticDataset:
+    """Deterministic synthetic classification data.
+
+    Labels are derived from the inputs so a model can actually fit them —
+    loss decreasing on synthetic data is the e2e smoke assertion
+    (SURVEY §4's WaitCondition-style check), which pure-noise labels would
+    not support.
+    """
+
+    shape: tuple[int, ...] = (28, 28, 1)
+    num_classes: int = 10
+    batch_size: int = 32
+    seed: int = 0
+    dtype: str = "float32"
+
+    noise_scale: float = 1.0
+
+    def batches(self, steps: int) -> Iterator[Batch]:
+        rng = np.random.default_rng(self.seed)
+        # Each class has a fixed random template; samples are template +
+        # noise.  Learnable in a few dozen steps, so "loss decreases" is a
+        # meaningful assertion, while noise keeps it from being trivial.
+        templates = rng.standard_normal((self.num_classes, *self.shape)).astype(
+            np.float32
+        )
+        for _ in range(steps):
+            y = rng.integers(0, self.num_classes, size=self.batch_size).astype(np.int32)
+            noise = rng.standard_normal((self.batch_size, *self.shape)).astype(
+                np.float32
+            )
+            x = (templates[y] + self.noise_scale * noise).astype(self.dtype)
+            yield Batch(x=x, y=y)
+
+    @classmethod
+    def mnist_like(cls, batch_size: int, seed: int = 0) -> "SyntheticDataset":
+        return cls(shape=(28, 28, 1), num_classes=10, batch_size=batch_size, seed=seed)
+
+    @classmethod
+    def imagenet_like(
+        cls, batch_size: int, image_size: int = 224, seed: int = 0, dtype: str = "float32"
+    ) -> "SyntheticDataset":
+        return cls(
+            shape=(image_size, image_size, 3),
+            num_classes=1000,
+            batch_size=batch_size,
+            seed=seed,
+            dtype=dtype,
+        )
+
+
+@dataclass
+class SyntheticTokenDataset:
+    """Synthetic LM token streams for BERT/Llama-style trainers."""
+
+    seq_len: int = 512
+    vocab_size: int = 32000
+    batch_size: int = 8
+    seed: int = 0
+
+    def batches(self, steps: int) -> Iterator[Batch]:
+        rng = np.random.default_rng(self.seed)
+        for _ in range(steps):
+            tokens = rng.integers(
+                1, self.vocab_size, size=(self.batch_size, self.seq_len), dtype=np.int32
+            )
+            # Next-token targets: inputs shifted left (causal LM objective).
+            yield Batch(x=tokens, y=np.roll(tokens, -1, axis=1))
+
+
+def device_put_batch(batch: Batch, sharding) -> tuple[jax.Array, jax.Array]:
+    """Place a host batch onto the mesh with the batch sharding — the only
+    host->device transfer in the hot loop."""
+    return (
+        jax.device_put(batch.x, sharding),
+        jax.device_put(batch.y, sharding),
+    )
+
+
+def mnist_dir_candidates() -> list[str]:
+    """Default MNIST search path: shared-storage mount first, then local."""
+    return [
+        os.environ.get("DEEPLEARNING_STORAGE_MOUNT", "/mnt/dlcfn") + "/data/mnist",
+        os.path.expanduser("~/.cache/dlcfn/mnist"),
+    ]

@@ -1,0 +1,74 @@
+"""Training metrics / throughput logging.
+
+The _LoggerHook analog (cifar10_multi_machine_train.py:38-60): every N
+steps, log step, loss, and examples/sec.  Also the first-class profiling
+hook SURVEY §5 calls for: optional JAX profiler trace capture around a step
+window.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+import jax
+
+from deeplearning_cfn_tpu.utils.logging import get_logger
+
+log = get_logger("dlcfn.train")
+
+
+@dataclass
+class ThroughputLogger:
+    global_batch_size: int
+    log_every: int = 10
+    name: str = "train"
+    _t0: float = field(default_factory=time.perf_counter)
+    _last_step: int = 0
+    history: list[dict] = field(default_factory=list)
+
+    def step(self, step: int, loss: float) -> None:
+        if step % self.log_every:
+            return
+        now = time.perf_counter()
+        dsteps = step - self._last_step
+        examples_per_sec = (
+            self.global_batch_size * dsteps / (now - self._t0) if dsteps else 0.0
+        )
+        record = {
+            "step": step,
+            "loss": float(loss),
+            "examples_per_sec": examples_per_sec,
+        }
+        self.history.append(record)
+        log.info(
+            "%s step=%d loss=%.4f examples/sec=%.1f",
+            self.name,
+            step,
+            float(loss),
+            examples_per_sec,
+        )
+        self._t0 = now
+        self._last_step = step
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str | None):
+    """JAX profiler capture for a step window (xprof-viewable)."""
+    if not log_dir:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def block_and_time(fn, *args, **kwargs) -> tuple[object, float]:
+    """Run fn, block on its outputs, return (result, seconds)."""
+    t0 = time.perf_counter()
+    out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    return out, time.perf_counter() - t0

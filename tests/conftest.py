@@ -15,6 +15,18 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# In environments where a site hook imports jax before conftest runs (the
+# TPU image does, to register its PJRT plugin), the env vars above are too
+# late — override through the live config instead.  Backends have not been
+# initialized yet at collection time, so XLA_FLAGS still applies.  Guarded
+# so control-plane-only test runs don't pay the jax import.
+import sys  # noqa: E402
+
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

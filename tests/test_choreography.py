@@ -6,7 +6,7 @@ storage retention.
 
 import pytest
 
-from deeplearning_cfn_tpu.cluster.bootstrap import CLUSTER_READY_RESOURCE
+from deeplearning_cfn_tpu.cluster.bootstrap import cluster_ready_resource
 from deeplearning_cfn_tpu.cluster.contract import ClusterContract
 from deeplearning_cfn_tpu.config.schema import ClusterSpec, JobSpec, NodePool, StorageSpec, TimeoutSpec
 from deeplearning_cfn_tpu.provision.backend import ResourceSignal
@@ -44,7 +44,7 @@ def test_happy_path_full_capacity(contract_root):
     assert result.contract.worker_ips[1:] == sorted(result.contract.worker_ips[1:])
     # Membership frozen after the hostfile is cut (lambda_function.py:129-132).
     assert backend.describe_group(GROUP).replace_unhealthy_suspended
-    assert backend.get_resource_signal(CLUSTER_READY_RESOURCE) is ResourceSignal.SUCCESS
+    assert backend.get_resource_signal(cluster_ready_resource("test-cluster")) is ResourceSignal.SUCCESS
 
 
 def test_contract_files_published(contract_root):
