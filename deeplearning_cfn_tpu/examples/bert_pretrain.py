@@ -1,0 +1,64 @@
+"""BERT masked-LM pretraining — data-parallel over ICI.
+
+The BASELINE.json "BERT-base pretraining" config ("new examples/jax-bert").
+Run: ``python -m deeplearning_cfn_tpu.examples.bert_pretrain --steps 100``
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning_cfn_tpu.examples.common import base_parser, default_mesh, maybe_init_distributed
+from deeplearning_cfn_tpu.models import bert
+from deeplearning_cfn_tpu.train.checkpoint import Checkpointer
+from deeplearning_cfn_tpu.train.data import SyntheticMLMDataset
+from deeplearning_cfn_tpu.train.metrics import ThroughputLogger
+from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
+
+
+def main(argv: list[str] | None = None) -> dict:
+    p = base_parser(__doc__)
+    p.add_argument("--seq_len", type=int, default=128)
+    p.add_argument("--tiny", action="store_true", help="tiny config for smokes")
+    args = p.parse_args(argv)
+    maybe_init_distributed()
+    cfg = bert.BertConfig.tiny(seq_len=args.seq_len) if args.tiny else bert.BertConfig.base()
+    batch = args.global_batch_size or 8 * len(jax.devices())
+    model = bert.BertEncoder(cfg)
+    mesh = default_mesh(args.strategy)
+    trainer = Trainer(
+        model,
+        mesh,
+        TrainerConfig(
+            strategy=args.strategy,
+            optimizer="adamw",
+            learning_rate=args.learning_rate or 1e-4,
+            weight_decay=0.01,
+            grad_clip_norm=1.0,
+        ),
+        loss_fn=bert.mlm_loss(model),
+    )
+    ds = SyntheticMLMDataset(
+        seq_len=args.seq_len, vocab_size=cfg.vocab_size, batch_size=batch
+    )
+    sample = next(iter(ds.batches(1)))
+    state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
+    ckpt = None
+    if args.checkpoint_dir:
+        ckpt = Checkpointer(args.checkpoint_dir)
+        restored = ckpt.restore_latest(state)
+        if restored is not None:
+            state, start = restored
+    logger = ThroughputLogger(global_batch_size=batch, log_every=args.log_every, name="bert")
+    state, losses = trainer.fit(
+        state, ds.batches(args.steps), steps=args.steps, logger=logger, checkpointer=ckpt
+    )
+    if ckpt:
+        ckpt.save(int(state.step), state)
+        ckpt.close()
+    return {"final_loss": losses[-1], "steps": len(losses)}
+
+
+if __name__ == "__main__":
+    print(main())

@@ -1,0 +1,90 @@
+"""Llama causal-LM training — FSDP x TP x SP over the provisioned slice.
+
+The BASELINE.json flagship: "Llama-3 8B (FSDP-style param sharding via pjit
+on the provisioned v5p slice)".  ``--size 8b`` selects the real shape;
+``--size tiny`` smokes the identical code path on small hardware.
+
+Run: ``python -m deeplearning_cfn_tpu.examples.llama_train --size tiny --steps 20``
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import jax
+import jax.numpy as jnp
+
+from deeplearning_cfn_tpu.examples.common import base_parser, maybe_init_distributed
+from deeplearning_cfn_tpu.models import llama
+from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
+from deeplearning_cfn_tpu.train.checkpoint import Checkpointer
+from deeplearning_cfn_tpu.train.data import SyntheticTokenDataset
+from deeplearning_cfn_tpu.train.metrics import ThroughputLogger
+from deeplearning_cfn_tpu.train.trainer import TrainerConfig
+
+
+def main(argv: list[str] | None = None) -> dict:
+    p = base_parser(__doc__)
+    p.add_argument("--size", choices=["tiny", "8b"], default="tiny")
+    p.add_argument("--seq_len", type=int, default=512)
+    p.add_argument("--fsdp", type=int, default=None, help="fsdp axis size (default: all devices)")
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--ring_attention", action="store_true")
+    args = p.parse_args(argv)
+    maybe_init_distributed()
+
+    n = len(jax.devices())
+    tp, sp = args.tp, args.sp
+    fsdp = args.fsdp or max(1, n // (tp * sp))
+    dp = max(1, n // (fsdp * tp * sp))
+    mesh = build_mesh(MeshSpec(dp=dp, fsdp=fsdp, sp=sp, tp=tp))
+
+    if args.size == "8b":
+        cfg = llama.LlamaConfig.llama3_8b()
+    else:
+        cfg = llama.LlamaConfig.tiny(vocab_size=512, seq_len=args.seq_len)
+    if args.ring_attention:
+        cfg = dataclasses.replace(cfg, use_ring_attention=True)
+
+    batch = args.global_batch_size or max(1, dp * fsdp) * 1
+    trainer = llama.make_trainer(
+        cfg,
+        mesh,
+        TrainerConfig(
+            strategy="fsdp",
+            optimizer="adamw",
+            learning_rate=args.learning_rate or 3e-4,
+            weight_decay=0.1,
+            grad_clip_norm=1.0,
+        ),
+    )
+    ds = SyntheticTokenDataset(
+        seq_len=args.seq_len, vocab_size=cfg.vocab_size, batch_size=batch
+    )
+    sample = next(iter(ds.batches(1)))
+    state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
+    ckpt = None
+    if args.checkpoint_dir:
+        ckpt = Checkpointer(args.checkpoint_dir)
+        restored = ckpt.restore_latest(state)
+        if restored is not None:
+            state, _ = restored
+    logger = ThroughputLogger(
+        global_batch_size=batch * args.seq_len, log_every=args.log_every, name="llama"
+    )
+    state, losses = trainer.fit(
+        state, ds.batches(args.steps), steps=args.steps, logger=logger, checkpointer=ckpt
+    )
+    if ckpt:
+        ckpt.save(int(state.step), state)
+        ckpt.close()
+    return {
+        "final_loss": losses[-1],
+        "steps": len(losses),
+        "mesh": {"dp": dp, "fsdp": fsdp, "sp": sp, "tp": tp},
+        "params": llama.param_count(cfg),
+    }
+
+
+if __name__ == "__main__":
+    print(main())

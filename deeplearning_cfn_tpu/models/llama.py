@@ -1,0 +1,266 @@
+"""Llama-3-family decoder — the framework's flagship large-model config.
+
+No reference analog exists (SURVEY §2.3: the reference is DP-only and
+vision-only); BASELINE.json names "Llama-3 8B FSDP via pjit on a v5p slice"
+as a first-class target, so this model is built TPU-first from scratch:
+
+- **Functional, not Module-boxed**: parameters are a plain pytree with a
+  parallel tree of PartitionSpecs (``param_specs``).  Sharding is data, so
+  the same model runs replicated, FSDP, FSDP x TP, or with sequence
+  sharding by swapping the spec tree — the pjit/GSPMD idiom.
+- **Scan over layers**: one stacked parameter per weight kind ([L, ...]),
+  ``lax.scan`` over the layer axis — one compiled block regardless of
+  depth, which keeps compile time and HBM for the 8B config sane.
+- **Remat per layer** (``jax.checkpoint``) trades recompute for activation
+  memory, the standard TPU recipe for fitting long sequences.
+- **GQA + RoPE + RMSNorm + SwiGLU**, bf16 compute with f32 softmax/norms.
+- Sequence axis annotated with ``sp`` sharding constraints so long-context
+  runs shard activations over the sequence axis; attention then induces
+  XLA all-gathers of K/V over ``sp`` (all-to-all context parallelism), and
+  the opt-in ring-attention path (parallel/ring_attention.py) replaces that
+  with a ppermute ring for the very long regime.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning_cfn_tpu.ops.attention import (
+    dot_product_attention,
+    rms_norm,
+    rotary_embedding,
+)
+
+BATCH_SPEC = P(("dp", "fsdp"), "sp")  # [batch, seq] token arrays
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    mlp_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    # Tie input/output embeddings (small configs); 8B does not tie.
+    tied_embeddings: bool = False
+    # Sequence-parallel ring attention (parallel/ring_attention.py) instead
+    # of dense attention: required when S/sp blocks are the only thing that
+    # fits; needs a mesh passed to forward().
+    use_ring_attention: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        return cls()  # defaults above are the 8B shape
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 256, seq_len: int = 128) -> "LlamaConfig":
+        return cls(
+            vocab_size=vocab_size,
+            dim=64,
+            n_layers=2,
+            n_heads=4,
+            n_kv_heads=2,
+            mlp_dim=128,
+            max_seq_len=seq_len,
+            remat=False,
+            tied_embeddings=True,
+        )
+
+
+# --- parameters ---------------------------------------------------------
+
+def init_params(cfg: LlamaConfig, rng: jax.Array) -> dict:
+    """Stacked-layer parameter pytree.  Weight layout chosen for the MXU:
+    every matmul is [in, out] so the forward is x @ W with no transposes."""
+    keys = jax.random.split(rng, 10)
+    d, hd = cfg.dim, cfg.head_dim
+    L = cfg.n_layers
+
+    def dense_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / np.sqrt(fan_in)).astype(
+            cfg.dtype
+        )
+
+    params = {
+        "embed": dense_init(keys[0], (cfg.vocab_size, d), d),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), jnp.float32),
+            "wq": dense_init(keys[1], (L, d, cfg.n_heads * hd), d),
+            "wk": dense_init(keys[2], (L, d, cfg.n_kv_heads * hd), d),
+            "wv": dense_init(keys[3], (L, d, cfg.n_kv_heads * hd), d),
+            "wo": dense_init(keys[4], (L, cfg.n_heads * hd, d), cfg.n_heads * hd),
+            "mlp_norm": jnp.ones((L, d), jnp.float32),
+            "w_gate": dense_init(keys[5], (L, d, cfg.mlp_dim), d),
+            "w_up": dense_init(keys[6], (L, d, cfg.mlp_dim), d),
+            "w_down": dense_init(keys[7], (L, cfg.mlp_dim, d), cfg.mlp_dim),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+    }
+    if not cfg.tied_embeddings:
+        params["output"] = dense_init(keys[8], (d, cfg.vocab_size), d)
+    return params
+
+
+def param_specs(cfg: LlamaConfig) -> dict:
+    """PartitionSpec tree: FSDP shards the embed/hidden axis, TP shards
+    heads/mlp/vocab — the standard 2D layout.  Layer axis (from scan
+    stacking) is never sharded."""
+    specs = {
+        "embed": P("tp", "fsdp"),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, "fsdp", "tp"),
+            "wk": P(None, "fsdp", "tp"),
+            "wv": P(None, "fsdp", "tp"),
+            "wo": P(None, "tp", "fsdp"),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, "fsdp", "tp"),
+            "w_up": P(None, "fsdp", "tp"),
+            "w_down": P(None, "tp", "fsdp"),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tied_embeddings:
+        specs["output"] = P("fsdp", "tp")
+    return specs
+
+
+def param_shardings(cfg: LlamaConfig, mesh: Mesh) -> dict:
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def param_count(cfg: LlamaConfig) -> int:
+    return sum(
+        int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(
+            jax.eval_shape(partial(init_params, cfg), jax.random.key(0))
+        )
+    )
+
+
+# --- forward ------------------------------------------------------------
+
+def _maybe_shard(x: jax.Array, spec: P) -> jax.Array:
+    """Apply a sharding hint when a mesh context is available; no-op
+    otherwise (bare PartitionSpecs need a context mesh, and the forward
+    stays mesh-agnostic — the trainer sets the context)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or not mesh.axis_names:
+        return x
+    return jax.lax.with_sharding_constraint(x, spec)
+
+def _block(
+    cfg: LlamaConfig,
+    mesh: Mesh | None,
+    x: jax.Array,
+    lp: dict,
+    positions: jax.Array,
+) -> jax.Array:
+    B, S, d = x.shape
+    hd = cfg.head_dim
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(B, S, cfg.n_heads, hd)
+    k = (h @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
+    v = (h @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+    q = rotary_embedding(q, positions, cfg.rope_theta)
+    k = rotary_embedding(k, positions, cfg.rope_theta)
+    if cfg.use_ring_attention and mesh is not None and mesh.shape.get("sp", 1) > 1:
+        from deeplearning_cfn_tpu.parallel.ring_attention import ring_attention
+
+        attn = ring_attention(q, k, v, mesh, causal=True)
+    else:
+        attn = dot_product_attention(q, k, v, causal=True)
+    x = x + attn.reshape(B, S, cfg.n_heads * hd) @ lp["wo"]
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    return x
+
+
+def forward(
+    cfg: LlamaConfig, params: dict, tokens: jax.Array, mesh: Mesh | None = None
+) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, V] (f32)."""
+    B, S = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = _maybe_shard(x, P(("dp", "fsdp"), "sp", None))
+    positions = jnp.arange(S, dtype=jnp.int32)
+
+    block = partial(_block, cfg, mesh)
+    if cfg.remat:
+        block = jax.checkpoint(block, static_argnums=())
+
+    def scan_body(carry, lp):
+        return block(carry, lp, positions), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if cfg.tied_embeddings:
+        logits = x @ params["embed"].astype(cfg.dtype).T
+    else:
+        logits = x @ params["output"]
+    return logits.astype(jnp.float32)
+
+
+class _FunctionalInit:
+    """Adapter giving the functional model the tiny surface Trainer.init
+    expects (a flax-style ``init`` returning {"params": ...})."""
+
+    def __init__(self, cfg: LlamaConfig):
+        self.cfg = cfg
+
+    def init(self, rng: jax.Array, sample: jax.Array) -> dict:
+        del sample
+        return {"params": init_params(self.cfg, rng)}
+
+
+def make_trainer(cfg: LlamaConfig, mesh: Mesh, trainer_config) -> Any:
+    """Wire a Llama config into the generic SPMD Trainer: explicit 2D
+    param shardings, token batch sharded over (dp/fsdp, sp), causal-LM loss."""
+    from deeplearning_cfn_tpu.train.trainer import Trainer
+
+    return Trainer(
+        _FunctionalInit(cfg),
+        mesh,
+        trainer_config,
+        loss_fn=lambda p, x, y: causal_lm_loss(cfg, p, x, y, mesh),
+        param_shardings=param_shardings(cfg, mesh),
+        batch_spec=BATCH_SPEC,
+    )
+
+
+def causal_lm_loss(
+    cfg: LlamaConfig,
+    params: dict,
+    tokens: jax.Array,
+    targets: jax.Array,
+    mesh: Mesh | None = None,
+) -> tuple[jax.Array, dict]:
+    """Mean next-token cross-entropy; last position excluded (its rolled
+    target wraps to the sequence start)."""
+    logits = forward(cfg, params, tokens, mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = jnp.ones_like(nll).at[:, -1].set(0.0)
+    loss = jnp.sum(nll * mask) / jnp.sum(mask)
+    return loss, {"perplexity": jnp.exp(loss)}

@@ -1,0 +1,1 @@
+from deeplearning_cfn_tpu.ops.attention import dot_product_attention  # noqa: F401

@@ -109,6 +109,35 @@ class SyntheticTokenDataset:
             yield Batch(x=tokens, y=np.roll(tokens, -1, axis=1))
 
 
+@dataclass
+class SyntheticMLMDataset:
+    """Masked-LM batches: 15% of tokens masked; targets are the original
+    ids at masked positions and -1 (ignore) elsewhere.  Token streams have
+    learnable structure (each position's distribution depends on the
+    previous token) so MLM loss genuinely decreases."""
+
+    seq_len: int = 128
+    vocab_size: int = 1000
+    batch_size: int = 8
+    seed: int = 0
+    mask_token: int = 0
+    mask_prob: float = 0.15
+
+    def batches(self, steps: int) -> Iterator[Batch]:
+        rng = np.random.default_rng(self.seed)
+        # Markov structure: token[i+1] = f(token[i]) + small noise.
+        perm = rng.permutation(self.vocab_size)
+        for _ in range(steps):
+            tokens = np.empty((self.batch_size, self.seq_len), np.int32)
+            tokens[:, 0] = rng.integers(1, self.vocab_size, self.batch_size)
+            for i in range(1, self.seq_len):
+                tokens[:, i] = perm[tokens[:, i - 1]]
+            masked = rng.random((self.batch_size, self.seq_len)) < self.mask_prob
+            x = np.where(masked, self.mask_token, tokens).astype(np.int32)
+            y = np.where(masked, tokens, -1).astype(np.int32)
+            yield Batch(x=x, y=y)
+
+
 def device_put_batch(batch: Batch, sharding) -> tuple[jax.Array, jax.Array]:
     """Place a host batch onto the mesh with the batch sharding — the only
     host->device transfer in the hot loop."""

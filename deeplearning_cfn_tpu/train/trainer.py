@@ -125,6 +125,7 @@ class Trainer:
         config: TrainerConfig,
         loss_fn: Callable[[Any, jax.Array, jax.Array], tuple[jax.Array, dict]] | None = None,
         param_shardings: Any = None,
+        batch_spec: P | None = None,
     ):
         self.model = model
         self.mesh = mesh
@@ -132,7 +133,11 @@ class Trainer:
         self.tx = _make_optimizer(config)
         self._custom_loss = loss_fn
         self._explicit_param_shardings = param_shardings
-        self.batch_sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+        # Images: [B, ...] split over the data axes.  Token models pass
+        # P(("dp","fsdp"), "sp") to also shard the sequence axis.
+        self.batch_sharding = NamedSharding(
+            mesh, batch_spec if batch_spec is not None else P(("dp", "fsdp"))
+        )
         self._step_fn = None
         self.state_shardings: TrainState | None = None
 
@@ -263,7 +268,10 @@ class Trainer:
         return self._step_fn
 
     def train_step(self, state: TrainState, x: jax.Array, y: jax.Array):
-        return self.step_fn(state, x, y)
+        # Mesh context makes bare-PartitionSpec sharding hints inside model
+        # code (e.g. llama._maybe_shard) resolvable during tracing.
+        with jax.set_mesh(self.mesh):
+            return self.step_fn(state, x, y)
 
     # --- convenience loop (the MonitoredTrainingSession analog) ----------
     def fit(
@@ -276,25 +284,35 @@ class Trainer:
     ) -> tuple[TrainState, list[float]]:
         losses: list[float] = []
         step_fn = self.step_fn
+        # Global step tracked host-side (syncing state.step every iteration
+        # would stall the dispatch pipeline); resume-aware so checkpoints
+        # after a restore are labeled with the true training step.
+        gstep = int(jax.device_get(state.step))
         for i, batch in enumerate(batches):
             if i >= steps:
                 break
             x = jax.device_put(jnp.asarray(batch.x), self.batch_sharding)
             y = jax.device_put(jnp.asarray(batch.y), self.batch_sharding)
-            state, metrics = step_fn(state, x, y)
+            with jax.set_mesh(self.mesh):
+                state, metrics = step_fn(state, x, y)
+            gstep += 1
             loss = float(metrics["loss"])
             losses.append(loss)
             if logger:
-                logger.step(i, loss)
-            if checkpointer is not None and checkpointer.should_save(i):
-                checkpointer.save(i, state)
+                logger.step(gstep, loss)
+            if checkpointer is not None and checkpointer.should_save(gstep):
+                checkpointer.save(gstep, state)
         return state, losses
 
     # --- compile diagnostics ---------------------------------------------
     def compile_stats(self, state: TrainState, x: jax.Array, y: jax.Array) -> dict:
         t0 = time.perf_counter()
-        lowered = self.step_fn.lower(state, x, y)
-        compiled = lowered.compile()
+        # Same mesh context as train_step: without it, in-model sharding
+        # hints are dropped and this would measure (and compile) a different
+        # program than the one that runs.
+        with jax.set_mesh(self.mesh):
+            lowered = self.step_fn.lower(state, x, y)
+            compiled = lowered.compile()
         cost = compiled.cost_analysis() or {}
         return {
             "compile_seconds": time.perf_counter() - t0,

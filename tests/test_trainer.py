@@ -102,3 +102,29 @@ def test_remat_and_bf16_compile():
     state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
     state, losses = trainer.fit(state, ds.batches(3), steps=3)
     assert np.isfinite(losses).all()
+
+
+def test_resnet_batchnorm_state_sharded_step():
+    # Mutable model_state (BatchNorm running stats) through the sharded
+    # train step: has_train_arg + mutable-collection branch under fsdp.
+    from deeplearning_cfn_tpu.models.resnet import ResNet
+
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=4))
+    tiny = ResNet(stage_sizes=(1, 1), num_classes=8, num_filters=16)
+    trainer = Trainer(
+        tiny,
+        mesh,
+        TrainerConfig(strategy="fsdp", learning_rate=0.1, has_train_arg=True),
+    )
+    ds = SyntheticDataset(shape=(32, 32, 3), num_classes=8, batch_size=16)
+    sample = next(iter(ds.batches(1)))
+    state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
+    before = jax.tree_util.tree_map(np.asarray, state.model_state)
+    state, losses = trainer.fit(state, ds.batches(3), steps=3)
+    assert np.isfinite(losses).all()
+    # Running stats actually updated.
+    after = state.model_state
+    diffs = jax.tree_util.tree_map(
+        lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()), before, after
+    )
+    assert max(jax.tree_util.tree_leaves(diffs)) > 0.0
