@@ -1,0 +1,224 @@
+"""``dlcfn`` — the operator CLI.
+
+Replaces the reference's stack driver scripts (C11:
+mask-rcnn-stack.sh/private-mask-rcnn-stack.sh — parameterize, create-stack,
+poll every 30 s printing elapsed time, describe) and the operator side of
+its runbooks (StackSetup.md).  Commands:
+
+  dlcfn validate <template.json> [-P k=v ...]     render + validate only
+  dlcfn create   <template.json> [-P k=v ...]     provision a cluster
+  dlcfn describe <template.json> [-P k=v ...]     realized state
+  dlcfn delete   <template.json> [--force-storage]
+  dlcfn plan     <template.json>                  render the launch plan
+  dlcfn run      <template.json>                  provision + run the job
+
+The local backend executes everything in-process (the fake cloud); the gcp
+backend renders the equivalent TPU API calls.  ``-P`` overrides template
+parameters, the analog of editing the stack script header vars
+(mask-rcnn-stack.sh:3-60).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from deeplearning_cfn_tpu.cluster.launcher import build_launch_plan
+from deeplearning_cfn_tpu.config.schema import ClusterSpec, ConfigError
+from deeplearning_cfn_tpu.config.template import render_template_file
+from deeplearning_cfn_tpu.utils.logging import get_logger
+
+log = get_logger("dlcfn.cli")
+
+
+def _parse_params(pairs: list[str]) -> dict[str, str]:
+    out = {}
+    for pair in pairs or []:
+        if "=" not in pair:
+            raise SystemExit(f"-P expects key=value, got {pair!r}")
+        k, v = pair.split("=", 1)
+        out[k] = v
+    return out
+
+
+def _load_spec(args) -> ClusterSpec:
+    try:
+        return render_template_file(args.template, _parse_params(args.param))
+    except FileNotFoundError as e:
+        raise SystemExit(f"template not found: {args.template}") from e
+    except json.JSONDecodeError as e:
+        raise SystemExit(f"template is not valid JSON: {e}") from e
+    except ConfigError as e:
+        raise SystemExit(f"template error: {e}") from e
+
+
+def _backend_for(spec: ClusterSpec):
+    if spec.backend == "local":
+        from deeplearning_cfn_tpu.provision.local import LocalBackend
+
+        return LocalBackend()
+    from deeplearning_cfn_tpu.provision.gcp import GCPBackend
+
+    return GCPBackend(
+        project=spec.project,
+        zone=spec.zone,
+        accelerator_type=spec.pool.accelerator_type,
+        runtime_version=spec.pool.runtime_version,
+    )
+
+
+def cmd_validate(args) -> int:
+    spec = _load_spec(args)
+    print(json.dumps(spec.to_dict(), indent=2, default=str))
+    print(
+        f"OK: {spec.pool.num_workers} workers x {spec.pool.chips_per_worker} chips "
+        f"({spec.pool.accelerator_type}) on backend {spec.backend}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def cmd_create(args) -> int:
+    from deeplearning_cfn_tpu.provision.provisioner import ProvisionFailure, Provisioner
+
+    spec = _load_spec(args)
+    backend = _backend_for(spec)
+    prov = Provisioner(backend, spec)
+    t0 = time.monotonic()
+    print(f"creating cluster {spec.name!r}...", file=sys.stderr)
+    try:
+        # The stack drivers poll every 30 s printing elapsed time
+        # (mask-rcnn-stack.sh:84-92); the local backend provisions inline so
+        # elapsed time is printed once at completion.
+        result = prov.provision()
+    except ProvisionFailure as e:
+        print(f"CREATE FAILED after {time.monotonic() - t0:.0f}s: {e}", file=sys.stderr)
+        return 1
+    elapsed = time.monotonic() - t0
+    print(
+        json.dumps(
+            {
+                "cluster": spec.name,
+                "elapsed_s": round(elapsed, 1),
+                "workers": result.realized_workers,
+                "chips": result.contract.total_chips,
+                "degraded": result.degraded,
+                "storage": result.storage.storage_id,
+                "contract_root": str(result.contract.root_dir()),
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
+def cmd_describe(args) -> int:
+    from deeplearning_cfn_tpu.provision.provisioner import Provisioner
+
+    spec = _load_spec(args)
+    backend = _backend_for(spec)
+    prov = Provisioner(backend, spec)
+    try:
+        desc = prov.describe()
+    except KeyError:
+        print(f"cluster {spec.name!r} not found on this backend", file=sys.stderr)
+        return 1
+    print(json.dumps(desc, indent=2))
+    return 0
+
+
+def cmd_delete(args) -> int:
+    from deeplearning_cfn_tpu.provision.provisioner import Provisioner
+
+    spec = _load_spec(args)
+    backend = _backend_for(spec)
+    prov = Provisioner(backend, spec)
+    out = prov.delete(force_storage=args.force_storage)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_plan(args) -> int:
+    from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+
+    spec = _load_spec(args)
+    # Render against a hypothetical full-size contract (no cloud calls).
+    ips = [f"10.0.0.{i + 2}" for i in range(spec.pool.num_workers)]
+    contract = ClusterContract.build(
+        cluster_name=spec.name,
+        coordinator_ip=ips[0],
+        other_worker_ips=ips[1:],
+        chips_per_worker=spec.pool.chips_per_worker,
+        storage_mount=spec.storage.mount_point,
+    )
+    plan = build_launch_plan(contract, spec.job)
+    print(f"# job {plan.job_name}: NUM_PARALLEL={plan.num_parallel} "
+          f"steps/epoch={plan.steps_per_epoch}")
+    for w in plan.workers:
+        print(f"# --- worker {w.process_id} ({w.host}) ---")
+        print(plan.render_script(w.process_id))
+    return 0
+
+
+def cmd_run(args) -> int:
+    from deeplearning_cfn_tpu.cluster.launcher import LaunchError, LocalJobRunner
+    from deeplearning_cfn_tpu.provision.provisioner import ProvisionFailure, Provisioner
+
+    spec = _load_spec(args)
+    backend = _backend_for(spec)
+    prov = Provisioner(backend, spec)
+    try:
+        result = prov.provision()
+        plan = build_launch_plan(result.contract, spec.job, result.job_violation)
+    except (ProvisionFailure, LaunchError) as e:
+        print(f"RUN FAILED: {e}", file=sys.stderr)
+        return 1
+    if spec.backend == "local":
+        import importlib
+
+        module = importlib.import_module(spec.job.module)
+        job_args = []
+        for k, v in sorted(spec.job.args.items()):
+            job_args += [f"--{k}", str(v)]
+        runner = LocalJobRunner(plan)
+        out = runner.run(module.main, job_args)
+        print(json.dumps({"job": spec.job.name, "result": out}, default=str))
+        return 0
+    for w in plan.workers:
+        print(f"# worker {w.process_id} launch script:")
+        print(plan.render_script(w.process_id))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="dlcfn", description=__doc__.split("\n")[0])
+    sub = parser.add_subparsers(dest="command", required=True)
+    for name, fn in [
+        ("validate", cmd_validate),
+        ("create", cmd_create),
+        ("describe", cmd_describe),
+        ("delete", cmd_delete),
+        ("plan", cmd_plan),
+        ("run", cmd_run),
+    ]:
+        p = sub.add_parser(name)
+        p.add_argument("template", type=Path)
+        p.add_argument(
+            "-P",
+            "--param",
+            action="append",
+            default=[],
+            help="template parameter override key=value (repeatable)",
+        )
+        if name == "delete":
+            p.add_argument("--force-storage", action="store_true")
+        p.set_defaults(fn=fn)
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

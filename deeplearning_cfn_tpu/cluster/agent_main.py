@@ -1,0 +1,90 @@
+"""On-VM bootstrap agent entry point — what every TPU VM runs at boot.
+
+The cfn-init/UserData analog (deeplearning.template:490-516): the queued
+resource's startup-script runs this module on every worker VM.  Role and
+rendezvous come from instance metadata / env, not SSH pushes:
+
+  DLCFN_CLUSTER          cluster name (required)
+  DLCFN_WORKER_INDEX     this VM's index in the slice (0 = coordinator)
+  DLCFN_BROKER           host:port of the rendezvous broker
+  DLCFN_GROUPS           comma-separated worker-group names
+  DLCFN_STORAGE_MOUNT    shared storage mount point
+  DLCFN_BOOTSTRAP_BUDGET_S  wallclock budget (default 2700, the
+                            reference's 3300-600; dl_cfn_setup_v2.py:411-415)
+
+Worker 0 runs the coordinator role (waits for group-success, harvests IPs,
+broadcasts the contract, signals ready); everyone else waits for the
+broadcast.  Both end by writing the cluster contract locally, after which
+the training job can `source env.sh` and `jax.distributed.initialize`.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from deeplearning_cfn_tpu.cluster.bootstrap import BootstrapAgent, BootstrapError
+from deeplearning_cfn_tpu.cluster.broker_client import BrokerQueue
+from deeplearning_cfn_tpu.utils.logging import get_logger
+from deeplearning_cfn_tpu.utils.timeouts import BudgetExhausted, TimeoutBudget
+
+log = get_logger("dlcfn.agent")
+
+
+def _my_ip() -> str:
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    finally:
+        s.close()
+
+
+def main() -> int:
+    cluster = os.environ.get("DLCFN_CLUSTER")
+    if not cluster:
+        log.error("DLCFN_CLUSTER not set; refusing to bootstrap")
+        return 2
+    index = int(os.environ.get("DLCFN_WORKER_INDEX", "0"))
+    broker = os.environ.get("DLCFN_BROKER", "127.0.0.1:8477")
+    host, port = broker.rsplit(":", 1)
+    groups = os.environ.get("DLCFN_GROUPS", f"{cluster}-workers").split(",")
+    budget_s = float(os.environ.get("DLCFN_BOOTSTRAP_BUDGET_S", "2700"))
+
+    # The on-VM agent has no cloud-API backend: instance harvesting happens
+    # on the controller side; the agent needs only the two queues.  A
+    # null backend satisfies the coordinator's signal call by writing a
+    # local marker the controller's poll picks up via the broker.
+    from deeplearning_cfn_tpu.provision.local import LocalBackend
+
+    backend = LocalBackend()
+
+    agent = BootstrapAgent(
+        backend=backend,
+        cluster_name=cluster,
+        coordinator_queue=BrokerQueue(f"{cluster}-coordinator-queue", host, int(port)),
+        worker_queue=BrokerQueue(f"{cluster}-worker-queue", host, int(port)),
+        group_names=groups,
+        budget=TimeoutBudget(budget_s),
+        storage_mount=os.environ.get("DLCFN_STORAGE_MOUNT", "/mnt/dlcfn"),
+    )
+    try:
+        if index == 0 and os.environ.get("DLCFN_ROLE") == "coordinator":
+            contract = agent.run_coordinator(_my_ip())
+        else:
+            contract = agent.run_worker()
+    except (BootstrapError, BudgetExhausted) as e:
+        log.error("bootstrap failed: %s", e)
+        return 1
+    log.info(
+        "bootstrap complete: %d workers, I am process %d",
+        contract.workers_count,
+        index,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,198 @@
+"""Client for the native rendezvous broker (native/broker/broker.cpp).
+
+``BrokerQueue`` implements the same :class:`RendezvousQueue` interface as
+the in-memory queue, over the broker's TCP line protocol — so the
+provisioner, bootstrap agents, and elasticity controller run unchanged
+against the production transport.  ``BrokerProcess`` builds (via make) and
+supervises a local broker instance; on a TPU deployment the broker runs on
+the coordinator VM and workers connect to
+``$DEEPLEARNING_COORDINATOR_HOST:<port>``.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import socket
+import subprocess
+import time
+from pathlib import Path
+from typing import Any
+
+from deeplearning_cfn_tpu.cluster.queue import Message, RendezvousQueue
+from deeplearning_cfn_tpu.utils.logging import get_logger
+
+log = get_logger("dlcfn.broker")
+
+BROKER_DIR = Path(__file__).resolve().parents[2] / "native" / "broker"
+BROKER_BIN = BROKER_DIR / "dlcfn-broker"
+
+
+class BrokerError(RuntimeError):
+    pass
+
+
+class BrokerConnection:
+    """One TCP connection speaking the broker line protocol."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout_s)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._buf = b""
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _read_line(self) -> str:
+        while b"\n" not in self._buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise BrokerError("broker closed connection")
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\n", 1)
+        return line.decode()
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise BrokerError("broker closed connection")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def ping(self) -> bool:
+        self.sock.sendall(b"PING\n")
+        return self._read_line() == "PONG"
+
+    def send(self, queue: str, body: bytes) -> str:
+        self.sock.sendall(f"SEND {queue} {len(body)}\n".encode() + body)
+        resp = self._read_line()
+        if not resp.startswith("OK "):
+            raise BrokerError(f"SEND failed: {resp}")
+        return resp[3:]
+
+    def receive(self, queue: str, max_messages: int, visibility_ms: int) -> list[tuple[str, str, int, bytes]]:
+        self.sock.sendall(f"RECV {queue} {max_messages} {visibility_ms}\n".encode())
+        header = self._read_line()
+        if not header.startswith("N "):
+            raise BrokerError(f"RECV failed: {header}")
+        out = []
+        for _ in range(int(header[2:])):
+            mline = self._read_line().split(" ")
+            if mline[0] != "MSG":
+                raise BrokerError(f"bad MSG frame: {mline}")
+            _, mid, receipt, count, length = mline
+            out.append((mid, receipt, int(count), self._read_exact(int(length))))
+        return out
+
+    def delete(self, queue: str, receipt: str) -> bool:
+        self.sock.sendall(f"DEL {queue} {receipt}\n".encode())
+        return self._read_line() == "OK"
+
+    def depth(self, queue: str) -> int:
+        self.sock.sendall(f"DEPTH {queue}\n".encode())
+        resp = self._read_line()
+        if not resp.startswith("OK "):
+            raise BrokerError(f"DEPTH failed: {resp}")
+        return int(resp[3:])
+
+    def purge(self, queue: str) -> None:
+        self.sock.sendall(f"PURGE {queue}\n".encode())
+        if self._read_line() != "OK":
+            raise BrokerError("PURGE failed")
+
+
+class BrokerQueue(RendezvousQueue):
+    """RendezvousQueue over the native broker."""
+
+    def __init__(self, name: str, host: str = "127.0.0.1", port: int = 8477):
+        self.name = name
+        self._conn = BrokerConnection(host, port)
+
+    def send(self, body: dict[str, Any]) -> str:
+        return self._conn.send(self.name, json.dumps(body).encode())
+
+    def receive(
+        self, max_messages: int = 10, visibility_timeout_s: float = 60.0
+    ) -> list[Message]:
+        raw = self._conn.receive(
+            self.name, max_messages, int(visibility_timeout_s * 1000)
+        )
+        return [
+            Message(
+                message_id=mid,
+                body=json.loads(payload.decode()),
+                receipt=receipt,
+                receive_count=count,
+            )
+            for mid, receipt, count, payload in raw
+        ]
+
+    def delete(self, receipt: str) -> None:
+        self._conn.delete(self.name, receipt)
+
+    def purge(self) -> None:
+        self._conn.purge(self.name)
+
+    def approximate_depth(self) -> int:
+        return self._conn.depth(self.name)
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+def build_broker(force: bool = False) -> Path:
+    """Compile the broker with make (idempotent)."""
+    if BROKER_BIN.exists() and not force:
+        return BROKER_BIN
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        raise BrokerError("make/g++ not available to build the broker")
+    subprocess.run(["make", "-C", str(BROKER_DIR)], check=True, capture_output=True)
+    return BROKER_BIN
+
+
+class BrokerProcess:
+    """Build + spawn + supervise a local broker (ephemeral port by default)."""
+
+    def __init__(self, port: int = 0):
+        build_broker()
+        self.proc = subprocess.Popen(
+            [str(BROKER_BIN), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        assert self.proc.stdout is not None
+        line = self.proc.stdout.readline()
+        if "listening on" not in line:
+            raise BrokerError(f"broker failed to start: {line!r}")
+        self.port = int(line.strip().rsplit(" ", 1)[-1])
+        # Wait until accepting.
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            try:
+                BrokerConnection("127.0.0.1", self.port, timeout_s=1.0).ping()
+                return
+            except OSError:
+                time.sleep(0.05)
+        raise BrokerError("broker did not become reachable")
+
+    def queue(self, name: str) -> BrokerQueue:
+        return BrokerQueue(name, "127.0.0.1", self.port)
+
+    def stop(self) -> None:
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+
+    def __enter__(self) -> "BrokerProcess":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -20,6 +20,7 @@ Fault injection knobs:
 from __future__ import annotations
 
 import itertools
+from typing import Callable
 
 from deeplearning_cfn_tpu.cluster.queue import InMemoryQueue, RendezvousQueue
 from deeplearning_cfn_tpu.provision.backend import (
@@ -41,13 +42,18 @@ class LocalBackend(Backend):
         fail_instance_indices: dict[str, set[int]] | None = None,
         duplicate_events: bool = False,
         launch_delay_s: float = 0.0,
+        queue_factory: Callable[[str], RendezvousQueue] | None = None,
     ):
+        """``queue_factory(name) -> RendezvousQueue`` swaps the transport
+        (e.g. the native broker) while keeping the fake compute plane —
+        used to run the full choreography over the production queue path."""
+        self.queue_factory = queue_factory
         self.clock = clock or MonotonicClock()
         self.events = EventBus()
         self.fail_instance_indices = fail_instance_indices or {}
         self.duplicate_events = duplicate_events
         self.launch_delay_s = launch_delay_s
-        self._queues: dict[str, InMemoryQueue] = {}
+        self._queues: dict[str, RendezvousQueue] = {}
         self._groups: dict[str, WorkerGroup] = {}
         self._instances: dict[str, Instance] = {}
         self._storage: dict[str, StorageHandle] = {}
@@ -58,7 +64,10 @@ class LocalBackend(Backend):
     # --- queues ---------------------------------------------------------
     def create_queue(self, name: str) -> RendezvousQueue:
         if name not in self._queues:
-            self._queues[name] = InMemoryQueue(name, clock=self.clock)
+            if self.queue_factory is not None:
+                self._queues[name] = self.queue_factory(name)
+            else:
+                self._queues[name] = InMemoryQueue(name, clock=self.clock)
         return self._queues[name]
 
     def get_queue(self, name: str) -> RendezvousQueue:

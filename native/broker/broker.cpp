@@ -1,0 +1,263 @@
+// dlcfn-broker: the control-plane rendezvous service.
+//
+// TPU-native replacement for the transport the reference rented from AWS
+// SQS (SURVEY §2.4): two queues carry the whole cluster choreography —
+// controller -> coordinator group-setup events and the coordinator ->
+// workers contract broadcast.  On a TPU deployment this broker runs on the
+// coordinator VM (or any reachable host) and every bootstrap agent speaks
+// the line protocol below; the in-memory Python queue used by tests
+// implements identical semantics (cluster/queue.py).
+//
+// Semantics reproduced exactly (they are load-bearing, see queue.py):
+//   * at-least-once delivery (receipts; unacked messages reappear)
+//   * per-receive visibility timeout in milliseconds
+//   * visibility 0 + no delete = broadcast (dl_cfn_setup_v2.py:180-190)
+//   * FIFO by enqueue sequence among visible messages
+//
+// Wire protocol (text framing, bodies are opaque bytes so no JSON parsing
+// happens in the broker — the Python client JSON-encodes):
+//   SEND <queue> <len>\n<payload>         -> OK <message_id>\n
+//   RECV <queue> <max> <visibility_ms>\n  -> N <n>\n then n x:
+//                                            MSG <id> <receipt> <count> <len>\n<payload>
+//   DEL <queue> <receipt>\n               -> OK\n | MISS\n
+//   DEPTH <queue>\n                       -> OK <n>\n
+//   PURGE <queue>\n                       -> OK\n
+//   PING\n                                -> PONG\n
+//
+// Build: make (g++ -O2 -std=c++17 -pthread).  Run: dlcfn-broker <port>.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Stored {
+  std::string id;
+  std::string body;
+  uint64_t seq;
+  Clock::time_point invisible_until;
+  int receive_count = 0;
+  std::set<std::string> receipts;
+};
+
+struct Queue {
+  std::map<std::string, Stored> messages;  // id -> message
+};
+
+std::mutex g_mu;
+std::map<std::string, Queue> g_queues;
+std::atomic<uint64_t> g_seq{0};
+std::atomic<uint64_t> g_id{0};
+
+std::string next_id(const char* prefix) {
+  char buf[32];
+  std::snprintf(buf, sizeof buf, "%s-%012llx", prefix,
+                static_cast<unsigned long long>(++g_id));
+  return buf;
+}
+
+// --- protocol helpers ----------------------------------------------------
+
+bool read_line(int fd, std::string& line) {
+  line.clear();
+  char c;
+  while (true) {
+    ssize_t n = recv(fd, &c, 1, 0);
+    if (n <= 0) return false;
+    if (c == '\n') return true;
+    line.push_back(c);
+    if (line.size() > 1 << 16) return false;  // header sanity bound
+  }
+}
+
+bool read_exact(int fd, std::string& out, size_t len) {
+  out.resize(len);
+  size_t got = 0;
+  while (got < len) {
+    ssize_t n = recv(fd, &out[got], len - got, 0);
+    if (n <= 0) return false;
+    got += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool write_all(int fd, const std::string& data) {
+  size_t sent = 0;
+  while (sent < data.size()) {
+    ssize_t n = send(fd, data.data() + sent, data.size() - sent, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    sent += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+// --- operations ----------------------------------------------------------
+
+std::string op_send(const std::string& qname, std::string body) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  Queue& q = g_queues[qname];
+  Stored m;
+  m.id = next_id("m");
+  m.body = std::move(body);
+  m.seq = ++g_seq;
+  m.invisible_until = Clock::time_point{};  // immediately visible
+  std::string id = m.id;
+  q.messages.emplace(id, std::move(m));
+  return id;
+}
+
+struct Delivered {
+  std::string id, receipt, body;
+  int count;
+};
+
+std::vector<Delivered> op_recv(const std::string& qname, int max_messages,
+                               long visibility_ms) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  Queue& q = g_queues[qname];
+  auto now = Clock::now();
+  // Visible messages in FIFO order.
+  std::vector<Stored*> visible;
+  for (auto& [id, m] : q.messages)
+    if (m.invisible_until <= now) visible.push_back(&m);
+  std::sort(visible.begin(), visible.end(),
+            [](const Stored* a, const Stored* b) { return a->seq < b->seq; });
+  std::vector<Delivered> out;
+  for (Stored* m : visible) {
+    if (static_cast<int>(out.size()) >= max_messages) break;
+    m->receive_count++;
+    if (visibility_ms > 0)
+      m->invisible_until = now + std::chrono::milliseconds(visibility_ms);
+    std::string receipt = next_id("r");
+    m->receipts.insert(receipt);
+    out.push_back({m->id, receipt, m->body, m->receive_count});
+  }
+  return out;
+}
+
+bool op_del(const std::string& qname, const std::string& receipt) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  Queue& q = g_queues[qname];
+  for (auto it = q.messages.begin(); it != q.messages.end(); ++it) {
+    if (it->second.receipts.count(receipt)) {
+      q.messages.erase(it);
+      return true;
+    }
+  }
+  return false;  // unknown receipt: no-op, like SQS
+}
+
+size_t op_depth(const std::string& qname) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  return g_queues[qname].messages.size();
+}
+
+void op_purge(const std::string& qname) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  g_queues[qname].messages.clear();
+}
+
+// --- per-connection loop -------------------------------------------------
+
+void serve(int fd) {
+  std::string line;
+  while (read_line(fd, line)) {
+    std::istringstream ss(line);
+    std::string cmd;
+    ss >> cmd;
+    if (cmd == "PING") {
+      if (!write_all(fd, "PONG\n")) break;
+    } else if (cmd == "SEND") {
+      std::string qname;
+      size_t len = 0;
+      ss >> qname >> len;
+      std::string body;
+      if (qname.empty() || len > (64u << 20) || !read_exact(fd, body, len)) break;
+      std::string id = op_send(qname, std::move(body));
+      if (!write_all(fd, "OK " + id + "\n")) break;
+    } else if (cmd == "RECV") {
+      std::string qname;
+      int maxm = 10;
+      long vis_ms = 0;
+      ss >> qname >> maxm >> vis_ms;
+      if (qname.empty()) break;
+      auto msgs = op_recv(qname, maxm, vis_ms);
+      std::string resp = "N " + std::to_string(msgs.size()) + "\n";
+      for (auto& m : msgs) {
+        resp += "MSG " + m.id + " " + m.receipt + " " + std::to_string(m.count) +
+                " " + std::to_string(m.body.size()) + "\n" + m.body;
+      }
+      if (!write_all(fd, resp)) break;
+    } else if (cmd == "DEL") {
+      std::string qname, receipt;
+      ss >> qname >> receipt;
+      if (!write_all(fd, op_del(qname, receipt) ? "OK\n" : "MISS\n")) break;
+    } else if (cmd == "DEPTH") {
+      std::string qname;
+      ss >> qname;
+      if (!write_all(fd, "OK " + std::to_string(op_depth(qname)) + "\n")) break;
+    } else if (cmd == "PURGE") {
+      std::string qname;
+      ss >> qname;
+      op_purge(qname);
+      if (!write_all(fd, "OK\n")) break;
+    } else {
+      if (!write_all(fd, "ERR unknown command\n")) break;
+    }
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = argc > 1 ? std::atoi(argv[1]) : 8477;
+  int listener = socket(AF_INET, SOCK_STREAM, 0);
+  if (listener < 0) {
+    std::perror("socket");
+    return 1;
+  }
+  int one = 1;
+  setsockopt(listener, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(listener, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0) {
+    std::perror("bind");
+    return 1;
+  }
+  if (listen(listener, 64) != 0) {
+    std::perror("listen");
+    return 1;
+  }
+  // Report the actual port (port 0 = ephemeral, used by tests).
+  socklen_t alen = sizeof addr;
+  getsockname(listener, reinterpret_cast<sockaddr*>(&addr), &alen);
+  std::printf("dlcfn-broker listening on %d\n", ntohs(addr.sin_port));
+  std::fflush(stdout);
+  while (true) {
+    int fd = accept(listener, nullptr, nullptr);
+    if (fd < 0) continue;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    std::thread(serve, fd).detach();
+  }
+}
