@@ -60,6 +60,9 @@ class LlamaConfig:
     # of dense attention: required when S/sp blocks are the only thing that
     # fits; needs a mesh passed to forward().
     use_ring_attention: bool = False
+    # Pallas flash-attention kernel (ops/pallas_attention.py) instead of XLA
+    # attention: blockwise online softmax, never materializes [S, S] in HBM.
+    use_flash_attention: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -188,7 +191,13 @@ def _block(
         from deeplearning_cfn_tpu.parallel.ring_attention import ring_attention
 
         attn = ring_attention(q, k, v, mesh, causal=True)
+    elif cfg.use_flash_attention and jax.default_backend() == "tpu":
+        from deeplearning_cfn_tpu.ops.pallas_attention import flash_attention
+
+        attn = flash_attention(q, k, v, causal=True, mesh=mesh)
     else:
+        # Includes use_flash_attention off-TPU: the Pallas kernel would run
+        # in interpret mode (slow); XLA attention is equivalent there.
         attn = dot_product_attention(q, k, v, causal=True)
     x = x + attn.reshape(B, S, cfg.n_heads * hd) @ lp["wo"]
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)

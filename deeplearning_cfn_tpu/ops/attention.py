@@ -10,7 +10,8 @@ BASELINE.json) are transformers.  Two paths:
   everything on-chip for moderate sequence lengths.
 - ``flash_attention``: Pallas blockwise-softmax kernel (ops/pallas_attention)
   for long sequences where materializing the [S, S] score matrix would blow
-  HBM bandwidth; falls back to the XLA path off-TPU.
+  HBM bandwidth.  Off-TPU it runs in Pallas interpret mode (identical
+  numerics, slow) — dispatch to ``dot_product_attention`` there instead.
 
 Both are pure functions of [batch, seq, heads, head_dim] tensors, grouped-
 query aware (kv heads may be fewer than q heads).
@@ -20,6 +21,9 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+
+from deeplearning_cfn_tpu.ops.pallas_attention import flash_attention  # noqa: F401  (public re-export)
 
 
 def _repeat_kv(k: jax.Array, num_q_heads: int) -> jax.Array:

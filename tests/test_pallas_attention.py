@@ -1,0 +1,125 @@
+"""Flash attention (Pallas kernel, interpret mode on CPU) vs XLA attention.
+
+Covers: causal/non-causal, GQA, non-divisible sequence lengths (padding +
+masking), and gradients through the custom VJP.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_cfn_tpu.ops.attention import dot_product_attention
+from deeplearning_cfn_tpu.ops.pallas_attention import flash_attention
+
+
+def _qkv(b=2, s=64, hq=4, hkv=2, d=16, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, s, hq, d)), dtype)
+    k = jnp.asarray(rng.standard_normal((b, s, hkv, d)), dtype)
+    v = jnp.asarray(rng.standard_normal((b, s, hkv, d)), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_matches_xla_attention(causal):
+    q, k, v = _qkv()
+    ref = dot_product_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_gqa_head_mapping():
+    q, k, v = _qkv(hq=8, hkv=2)
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ragged_seq_len_padding():
+    # 50 is not a multiple of any block size → exercises padding + kv mask.
+    q, k, v = _qkv(s=50)
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_bf16_io():
+    q, k, v = _qkv(dtype=jnp.bfloat16)
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2, rtol=3e-2
+    )
+
+
+@pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2)])
+def test_gradients_match(hq, hkv):
+    q, k, v = _qkv(s=48, hq=hq, hkv=hkv)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, None, 16, 16) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_bad_gqa_ratio_raises():
+    q, k, v = _qkv(hq=6, hkv=4)
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        flash_attention(q, k, v)
+
+
+def test_mesh_shard_map_path():
+    from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh, virtual_cpu_devices
+
+    mesh = build_mesh(MeshSpec(dp=2, tp=2), virtual_cpu_devices(4))
+    q, k, v = _qkv(b=4, s=32, hq=4, hkv=2)
+    ref = dot_product_attention(q, k, v, causal=True)
+
+    def loss_mesh(q, k, v):
+        out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16, mesh=mesh)
+        return jnp.sum(out**2), out
+
+    (val, out), grads = jax.value_and_grad(loss_mesh, argnums=(0, 1, 2), has_aux=True)(
+        q, k, v
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(grads, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("spec_kw", [{"dp": 2, "sp": 2}, {"sp": 4}])
+def test_mesh_sp_sharding_rejected(spec_kw):
+    from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh, virtual_cpu_devices
+
+    mesh = build_mesh(MeshSpec(**spec_kw), virtual_cpu_devices(4))
+    q, k, v = _qkv(s=32)
+    with pytest.raises(ValueError, match="ring_attention"):
+        flash_attention(q, k, v, mesh=mesh)
+
+
+def test_jit_and_value_and_grad():
+    q, k, v = _qkv(s=32)
+
+    @jax.jit
+    def step(q, k, v):
+        def loss(q):
+            return jnp.mean(flash_attention(q, k, v, True, None, 16, 16))
+
+        return jax.value_and_grad(loss)(q)
+
+    val, grad = step(q, k, v)
+    assert np.isfinite(float(val))
+    assert np.isfinite(np.asarray(grad)).all()
