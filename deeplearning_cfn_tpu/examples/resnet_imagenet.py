@@ -10,6 +10,8 @@ Run: ``python -m deeplearning_cfn_tpu.examples.resnet_imagenet --depth 50 --step
 
 from __future__ import annotations
 
+import argparse
+
 import jax
 import jax.numpy as jnp
 
@@ -26,7 +28,7 @@ def main(argv: list[str] | None = None) -> dict:
     p = base_parser(__doc__)
     p.add_argument("--depth", type=int, choices=sorted(DEPTHS), default=50)
     p.add_argument("--image_size", type=int, default=224)
-    p.add_argument("--bf16", action="store_true", default=True)
+    p.add_argument("--bf16", action=argparse.BooleanOptionalAction, default=True)
     args = p.parse_args(argv)
     maybe_init_distributed()
     batch = args.global_batch_size or 32 * len(jax.devices())

@@ -58,9 +58,12 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.float32
+    # When True, skip the classifier and return the {C2..C5} stage feature
+    # maps (stride 4..32) — the backbone interface detection FPNs consume.
+    return_features: bool = False
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
         norm = partial(
             nn.BatchNorm,
@@ -74,6 +77,7 @@ class ResNet(nn.Module):
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        features = {}
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
@@ -84,6 +88,9 @@ class ResNet(nn.Module):
                     norm=norm,
                     name=f"stage{i + 1}_block{j + 1}",
                 )(x)
+            features[f"C{i + 2}"] = x
+        if self.return_features:
+            return features
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
         return x

@@ -116,6 +116,10 @@ class Trainer:
 
     ``loss_fn(params, x, y) -> (loss, aux)`` may be supplied for custom
     objectives; the default is softmax cross-entropy classification.
+    Models with mutable collections (BatchNorm) and a custom objective use
+    ``stateful_loss_fn(params, model_state, x, y) ->
+    (loss, (aux, new_model_state))`` instead.  ``y`` may be any pytree whose
+    leaves lead with the batch axis (detection targets are dicts).
     """
 
     def __init__(
@@ -126,12 +130,14 @@ class Trainer:
         loss_fn: Callable[[Any, jax.Array, jax.Array], tuple[jax.Array, dict]] | None = None,
         param_shardings: Any = None,
         batch_spec: P | None = None,
+        stateful_loss_fn: Callable[..., tuple[jax.Array, tuple[dict, Any]]] | None = None,
     ):
         self.model = model
         self.mesh = mesh
         self.config = config
         self.tx = _make_optimizer(config)
         self._custom_loss = loss_fn
+        self._custom_stateful_loss = stateful_loss_fn
         self._explicit_param_shardings = param_shardings
         # Images: [B, ...] split over the data axes.  Token models pass
         # P(("dp","fsdp"), "sp") to also shard the sequence axis.
@@ -145,6 +151,8 @@ class Trainer:
     def _loss(
         self, params: Any, model_state: Any, x: jax.Array, y: jax.Array
     ) -> tuple[jax.Array, tuple[dict, Any]]:
+        if self._custom_stateful_loss is not None:
+            return self._custom_stateful_loss(params, model_state, x, y)
         if self._custom_loss is not None:
             loss, aux = self._custom_loss(params, x, y)
             return loss, (aux, model_state)
@@ -291,8 +299,11 @@ class Trainer:
         for i, batch in enumerate(batches):
             if i >= steps:
                 break
-            x = jax.device_put(jnp.asarray(batch.x), self.batch_sharding)
-            y = jax.device_put(jnp.asarray(batch.y), self.batch_sharding)
+            # Targets may be a pytree (e.g. detection {boxes, classes});
+            # every leaf leads with the batch axis, so one batch sharding
+            # applies uniformly — a single host->device transfer per batch.
+            x = jax.device_put(batch.x, self.batch_sharding)
+            y = jax.device_put(batch.y, self.batch_sharding)
             with jax.set_mesh(self.mesh):
                 state, metrics = step_fn(state, x, y)
             gstep += 1

@@ -1,0 +1,149 @@
+"""Detection stack tests: geometry vs brute force, loss behavior, NMS, and
+the loss-decreases training smoke (SURVEY §4's WaitCondition analog)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_cfn_tpu.models import retinanet
+
+
+def brute_force_iou(a, b):
+    out = np.zeros((len(a), len(b)), np.float32)
+    for i, (ay1, ax1, ay2, ax2) in enumerate(a):
+        for j, (by1, bx1, by2, bx2) in enumerate(b):
+            iy1, ix1 = max(ay1, by1), max(ax1, bx1)
+            iy2, ix2 = min(ay2, by2), min(ax2, bx2)
+            inter = max(iy2 - iy1, 0) * max(ix2 - ix1, 0)
+            ua = (ay2 - ay1) * (ax2 - ax1) + (by2 - by1) * (bx2 - bx1) - inter
+            out[i, j] = inter / ua if ua > 0 else 0.0
+    return out
+
+
+class TestGeometry:
+    def test_iou_matches_brute_force(self):
+        rng = np.random.default_rng(0)
+        pts = rng.uniform(0, 100, size=(6, 2, 2))
+        a = np.concatenate([pts.min(1), pts.max(1)], -1).astype(np.float32)
+        pts = rng.uniform(0, 100, size=(4, 2, 2))
+        b = np.concatenate([pts.min(1), pts.max(1)], -1).astype(np.float32)
+        got = np.asarray(retinanet.box_iou(jnp.asarray(a), jnp.asarray(b)))
+        np.testing.assert_allclose(got, brute_force_iou(a, b), atol=1e-5)
+
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.default_rng(1)
+        anchors = retinanet.generate_anchors(64)[:32]
+        pts = rng.uniform(0, 64, size=(32, 2, 2))
+        boxes = np.concatenate([pts.min(1), pts.max(1) + 1.0], -1).astype(np.float32)
+        deltas = retinanet.encode_boxes(jnp.asarray(anchors), jnp.asarray(boxes))
+        back = retinanet.decode_boxes(jnp.asarray(anchors), deltas)
+        np.testing.assert_allclose(np.asarray(back), boxes, rtol=1e-4, atol=1e-3)
+
+    def test_anchor_count_matches_head_output(self):
+        image_size = 64
+        anchors = retinanet.generate_anchors(image_size)
+        model = retinanet.RetinaNet(
+            num_classes=4, backbone_stages=(1, 1, 1, 1), fpn_channels=32
+        )
+        x = jnp.zeros((1, image_size, image_size, 3))
+        variables = model.init(jax.random.key(0), x, train=False)
+        (cls_out, box_out), _ = model.apply(
+            variables, x, train=True, mutable=["batch_stats"]
+        )
+        assert cls_out.shape == (1, anchors.shape[0], 4)
+        assert box_out.shape == (1, anchors.shape[0], 4)
+
+
+class TestMatching:
+    def test_perfect_anchor_is_foreground(self):
+        anchors = jnp.asarray(retinanet.generate_anchors(64))
+        gt_boxes = jnp.asarray(np.asarray(anchors)[100:101])  # exact anchor box
+        gt_classes = jnp.asarray([2], jnp.int32)
+        cls_t, box_t, fg = retinanet.match_anchors(anchors, gt_boxes, gt_classes)
+        assert bool(fg[100])
+        assert int(cls_t[100]) == 2
+        np.testing.assert_allclose(np.asarray(box_t[100]), 0.0, atol=1e-5)
+
+    def test_all_padding_is_background(self):
+        anchors = jnp.asarray(retinanet.generate_anchors(64))
+        gt_boxes = jnp.zeros((3, 4))
+        gt_classes = jnp.full((3,), -1, jnp.int32)
+        cls_t, _, fg = retinanet.match_anchors(anchors, gt_boxes, gt_classes)
+        assert not bool(jnp.any(fg))
+        assert bool(jnp.all(cls_t == -1))
+
+
+class TestLoss:
+    def test_focal_loss_ignores_ignored_anchors(self):
+        logits = jnp.zeros((2, 10, 5))
+        target = jnp.full((2, 10), -2)
+        loss = retinanet.focal_loss(logits, target, 5)
+        np.testing.assert_allclose(np.asarray(loss), 0.0)
+
+    def test_detection_loss_finite_and_positive(self):
+        anchors = jnp.asarray(retinanet.generate_anchors(64))
+        n = anchors.shape[0]
+        rng = jax.random.key(0)
+        cls_logits = jax.random.normal(rng, (2, n, 4))
+        box_deltas = jax.random.normal(rng, (2, n, 4))
+        gt_boxes = jnp.asarray([[[8, 8, 40, 40]], [[16, 16, 48, 48]]], jnp.float32)
+        gt_classes = jnp.asarray([[1], [3]], jnp.int32)
+        loss, aux = retinanet.detection_loss(
+            cls_logits, box_deltas, anchors, gt_boxes, gt_classes, 4
+        )
+        assert np.isfinite(float(loss)) and float(loss) > 0
+        assert float(aux["num_pos"]) >= 1
+
+
+class TestNMS:
+    def test_suppresses_overlapping_keeps_distinct(self):
+        boxes = jnp.asarray(
+            [
+                [0, 0, 10, 10],
+                [1, 1, 11, 11],  # overlaps box 0
+                [50, 50, 60, 60],  # distinct
+            ],
+            jnp.float32,
+        )
+        scores = jnp.asarray([0.9, 0.8, 0.7])
+        out_boxes, out_scores, valid = retinanet.nms_fixed(
+            boxes, scores, max_detections=3, iou_threshold=0.5
+        )
+        kept = np.asarray(out_scores)[np.asarray(valid)]
+        np.testing.assert_allclose(sorted(kept, reverse=True), [0.9, 0.7])
+
+    def test_predict_shapes_static(self):
+        anchors = jnp.asarray(retinanet.generate_anchors(64))
+        n = anchors.shape[0]
+        cls_logits = jax.random.normal(jax.random.key(1), (n, 4))
+        box_deltas = jnp.zeros((n, 4))
+        out = retinanet.predict(cls_logits, box_deltas, anchors, max_detections=10)
+        assert out["boxes"].shape == (10, 4)
+        assert out["scores"].shape == (10,)
+        assert out["classes"].shape == (10,)
+
+
+@pytest.mark.slow
+class TestTraining:
+    def test_loss_decreases(self):
+        from deeplearning_cfn_tpu.examples import detection_train
+
+        out = detection_train.main(
+            [
+                "--backbone", "tiny",
+                "--image_size", "64",
+                "--num_classes", "4",
+                "--max_boxes", "3",
+                "--global_batch_size", "8",
+                "--steps", "30",
+                "--learning_rate", "0.001",
+                "--optimizer", "adamw",
+                "--log_every", "1",
+            ]
+        )
+        history = out["history"]
+        assert out["steps"] == 30
+        first = np.mean([h["loss"] for h in history[:3]])
+        last = np.mean([h["loss"] for h in history[-3:]])
+        assert last < first, f"detection loss did not decrease: {first} -> {last}"
