@@ -60,13 +60,21 @@ def _backend_for(spec: ClusterSpec):
         from deeplearning_cfn_tpu.provision.local import LocalBackend
 
         return LocalBackend()
+    from deeplearning_cfn_tpu.cluster.startup import render_startup_script
     from deeplearning_cfn_tpu.provision.gcp import GCPBackend
 
     return GCPBackend(
         project=spec.project,
         zone=spec.zone,
         accelerator_type=spec.pool.accelerator_type,
-        runtime_version=spec.pool.runtime_version,
+        runtime_version=spec.pool.image_override or spec.pool.runtime_version,
+        network=spec.network.network,
+        subnetwork=spec.network.subnetwork,
+        external_ips=spec.network.external_ips,
+        disk_size_gb=spec.pool.disk_size_gb,
+        disk_type=spec.pool.disk_type,
+        spot=spec.pool.spot,
+        startup_script=render_startup_script(spec),
     )
 
 
@@ -163,6 +171,58 @@ def cmd_plan(args) -> int:
     return 0
 
 
+def cmd_startup_script(args) -> int:
+    from deeplearning_cfn_tpu.cluster.startup import render_startup_script
+
+    spec = _load_spec(args)
+    print(render_startup_script(spec), end="")
+    return 0
+
+
+def cmd_stage(args) -> int:
+    """Stage dataset/code artifacts — the prepare-s3-bucket.sh analog."""
+    import os
+
+    from deeplearning_cfn_tpu.provision.objectstore import (
+        LocalObjectStore,
+        Stager,
+    )
+
+    spec = _load_spec(args)
+    if not spec.staging.bucket:
+        raise SystemExit("template has no staging.bucket configured")
+    if spec.backend == "local":
+        root = Path(os.environ.get("DLCFN_ROOT", "/opt/deeplearning"))
+        store = LocalObjectStore(root / "buckets" / spec.staging.bucket)
+    else:
+        # Fail BEFORE tarring multi-GB artifacts: the CLI has no
+        # authenticated GCS transport of its own.  GCSObjectStore works when
+        # a deployment injects one (provision/objectstore.py); from a shell,
+        # gsutil is the direct route.
+        raise SystemExit(
+            "staging to GCS from the CLI requires an authenticated "
+            "transport; either use the library "
+            "(Stager(GCSObjectStore(bucket, transport))) or upload with "
+            f"`gsutil -m cp ... gs://{spec.staging.bucket}/{spec.staging.prefix}/`"
+        )
+    stager = Stager(store, prefix=spec.staging.prefix)
+    for path in args.data or []:
+        stager.stage_path(path)
+    for path in args.code or []:
+        stager.stage_path(path)
+    print(
+        json.dumps(
+            {
+                "bucket": spec.staging.bucket,
+                "prefix": spec.staging.prefix,
+                "artifacts": [vars(a) for a in stager.manifest],
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
 def cmd_run(args) -> int:
     from deeplearning_cfn_tpu.cluster.launcher import LaunchError, LocalJobRunner
     from deeplearning_cfn_tpu.provision.provisioner import ProvisionFailure, Provisioner
@@ -203,6 +263,8 @@ def main(argv: list[str] | None = None) -> int:
         ("delete", cmd_delete),
         ("plan", cmd_plan),
         ("run", cmd_run),
+        ("startup-script", cmd_startup_script),
+        ("stage", cmd_stage),
     ]:
         p = sub.add_parser(name)
         p.add_argument("template", type=Path)
@@ -215,6 +277,11 @@ def main(argv: list[str] | None = None) -> int:
         )
         if name == "delete":
             p.add_argument("--force-storage", action="store_true")
+        if name == "stage":
+            p.add_argument("--data", action="append", default=[],
+                           help="dataset file/dir to tar+upload (repeatable)")
+            p.add_argument("--code", action="append", default=[],
+                           help="code file/dir to tar+upload (repeatable)")
         p.set_defaults(fn=fn)
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,154 @@
+"""Worker startup-script rendering — the cfn-init configSet analog.
+
+The reference boots every node through UserData -> cfn-init running an
+ordered configSet (``Setup = [efs-config, download-setup,
+deeplearning-config]``, deeplearning.template:490-567); the Mask R-CNN
+stack extends it to 9 worker / 12 master steps adding S3 data+code staging
+with an EFS-vs-EBS placement condition guarded by a marker file
+(mask-rcnn-cfn.yaml:774-827,1039-1172) and conda-env auto-activation
+(:199-221).
+
+Here the same choreography renders to ONE bash script from the typed spec
+(no per-step cloud metadata), because a TPU slice's workers all boot the
+same image and the script is delivered via VM metadata.  Step order is
+preserved from the reference:
+
+1. storage-config   — mount shared storage (efs-config analog)
+2. staging-download — fetch dataset/code artifacts from the object store,
+                      marker-guarded shared-vs-local placement
+3. env-setup        — pinned pip deps + commands + login-shell activation
+4. agent            — exec the bootstrap/discovery agent (the
+                      deeplearning-config step running dl_cfn_setup_v2.py)
+"""
+
+from __future__ import annotations
+
+import shlex
+
+from deeplearning_cfn_tpu.config.schema import ClusterSpec
+
+# Marker file guarding one-time shared-storage data placement — the
+# data.txt trick of mask-rcnn-cfn.yaml:784-789 (cfn-init `test:` guards).
+DATA_MARKER = ".dlcfn-data-staged"
+
+
+def render_startup_script(spec: ClusterSpec) -> str:
+    """Render the full worker boot script for a cluster spec."""
+    lines: list[str] = [
+        "#!/bin/bash",
+        "set -euo pipefail",
+        # Log like cloud-init: everything teed to a well-known path
+        # (deeplearning.template:549,645).
+        "exec > >(tee -a /var/log/dlcfn-startup.log) 2>&1",
+        f"export DLCFN_CLUSTER={shlex.quote(spec.name)}",
+    ]
+    lines += _storage_steps(spec)
+    lines += _staging_steps(spec)
+    lines += _setup_steps(spec)
+    lines += _agent_step(spec)
+    return "\n".join(lines) + "\n"
+
+
+def _storage_steps(spec: ClusterSpec) -> list[str]:
+    mount = shlex.quote(spec.storage.mount_point)
+    steps = [f"mkdir -p {mount}"]
+    if spec.storage.kind == "filestore":
+        # efs-config analog: install client, mount, chown
+        # (deeplearning.template:524-538).  The address is delivered via VM
+        # metadata after storage creation; guard so a missing value degrades
+        # to a warning instead of aborting the boot under `set -u`.
+        steps += [
+            'DLCFN_FILESTORE_ADDR="${DLCFN_FILESTORE_ADDR:-'
+            "$(curl -sf -H 'Metadata-Flavor: Google' "
+            "http://metadata.google.internal/computeMetadata/v1/instance/attributes/dlcfn-filestore-addr "
+            '|| true)}"',
+            "if [ -n \"$DLCFN_FILESTORE_ADDR\" ]; then "
+            "command -v mount.nfs >/dev/null || "
+            "(apt-get update -qq && apt-get install -y -qq nfs-common); "
+            f'mount -t nfs -o rw,async "$DLCFN_FILESTORE_ADDR":/share {mount} '
+            f"&& chown -R \"$(id -un)\" {mount} "
+            "|| echo 'WARN: filestore mount failed'; "
+            "else echo 'WARN: no filestore address in metadata'; fi",
+        ]
+    elif spec.storage.kind == "gcs":
+        steps += [
+            'DLCFN_GCS_BUCKET="${DLCFN_GCS_BUCKET:-'
+            "$(curl -sf -H 'Metadata-Flavor: Google' "
+            "http://metadata.google.internal/computeMetadata/v1/instance/attributes/dlcfn-gcs-bucket "
+            '|| true)}"',
+            "if [ -n \"$DLCFN_GCS_BUCKET\" ] && command -v gcsfuse >/dev/null; then "
+            f'gcsfuse --implicit-dirs "$DLCFN_GCS_BUCKET" {mount} '
+            "|| echo 'WARN: gcs mount failed'; "
+            "else echo 'WARN: gcs bucket unset or gcsfuse missing'; fi",
+        ]
+    return steps
+
+
+def _staging_steps(spec: ClusterSpec) -> list[str]:
+    st = spec.staging
+    if not st.bucket:
+        return []
+    base = f"gs://{st.bucket}/{st.prefix}"
+    steps: list[str] = []
+    if st.datasets:
+        if st.data_on_shared_storage:
+            # One worker stages for everyone (EFSServesData=True path,
+            # mask-rcnn-cfn.yaml:1039-1068).  `mkdir` of the lock dir is the
+            # atomic election on shared NFS; losers wait for the completion
+            # marker so no one execs the agent against half-extracted data.
+            data_dir = f"{spec.storage.mount_point}/data"
+            marker = f"{data_dir}/{DATA_MARKER}"
+            lock = f"{data_dir}/.dlcfn-stage-lock"
+            steps.append(f"mkdir -p {shlex.quote(data_dir)}")
+            fetches = " && ".join(
+                f"gsutil -m cp {shlex.quote(f'{base}/{art}')} - | tar -x -C {shlex.quote(data_dir)}"
+                for art in st.datasets
+            )
+            steps.append(
+                f"if mkdir {shlex.quote(lock)} 2>/dev/null; then "
+                f"{fetches} && touch {shlex.quote(marker)}; "
+                f"else for i in $(seq 1 360); do "
+                f"[ -f {shlex.quote(marker)} ] && break; sleep 10; done; "
+                f"[ -f {shlex.quote(marker)} ] || echo 'WARN: staging wait timed out'; fi"
+            )
+        else:
+            # Every worker stages to local disk (EFSServesData=False /
+            # EBS path, mask-rcnn-cfn.yaml:774-789).
+            data_dir = "/mnt/disks/data"
+            steps.append(f"mkdir -p {data_dir}")
+            for art in st.datasets:
+                steps.append(
+                    f"gsutil -m cp {shlex.quote(f'{base}/{art}')} - | tar -x -C {data_dir}"
+                )
+    for art in st.code:
+        # Code lands in the home dir on every worker, like the tensorpack
+        # tar (mask-rcnn-cfn.yaml:1107-1130).
+        steps.append(
+            f"gsutil -m cp {shlex.quote(f'{base}/{art}')} - | tar -x -C \"$HOME\""
+        )
+    return steps
+
+
+def _setup_steps(spec: ClusterSpec) -> list[str]:
+    setup = spec.setup
+    steps: list[str] = []
+    if setup.pip_packages:
+        # Pinned dependency set on each worker (setup.sh:1-19 analog).
+        pkgs = " ".join(shlex.quote(p) for p in setup.pip_packages)
+        steps.append(f"python3 -m pip install --no-input -q {pkgs}")
+    steps.extend(setup.commands)
+    if setup.activate_env:
+        # ActivateCondaEnv analog: auto-activate in login shells
+        # (mask-rcnn-cfn.yaml:199-221 writes .bash_login).
+        act = shlex.quote(f"source {setup.activate_env}/bin/activate")
+        steps.append(f"echo {act} >> \"$HOME/.bash_login\"")
+    return steps
+
+
+def _agent_step(spec: ClusterSpec) -> list[str]:
+    del spec
+    return [
+        # deeplearning-config analog: run the discovery agent with the
+        # cluster identity in env (deeplearning.template:546-564).
+        "exec python3 -m deeplearning_cfn_tpu.cluster.agent_main",
+    ]

@@ -114,6 +114,10 @@ class NodePool:
     describes one slice; ``min_workers`` powers degrade-and-continue: if at
     least this many workers come up healthy the cluster proceeds at reduced
     size (lambda_function.py:142-169, README.md:49).
+
+    ``disk_size_gb``/``disk_type`` are the EBS volume sizing params of the
+    Mask R-CNN stack (mask-rcnn-cfn.yaml:54-73,190-198), mapped to the TPU
+    VM boot/data disk.
     """
 
     accelerator_type: str = "v5p-32"
@@ -124,8 +128,14 @@ class NodePool:
     image_override: str | None = None  # AMIOverride analog (mask-rcnn-cfn.yaml:155-160)
     reserved: bool = False
     spot: bool = False
+    disk_size_gb: int = 100
+    disk_type: str = "pd-balanced"
 
     def validate(self) -> None:
+        if self.disk_size_gb < 10:
+            raise ConfigError(f"disk_size_gb must be >= 10, got {self.disk_size_gb}")
+        if self.disk_type not in ("pd-standard", "pd-balanced", "pd-ssd"):
+            raise ConfigError(f"unknown disk_type {self.disk_type!r}")
         if self.accelerator_type not in ALLOWED_ACCELERATOR_TYPES:
             raise ConfigError(
                 f"accelerator_type {self.accelerator_type!r} not in allowed set "
@@ -154,6 +164,72 @@ class NodePool:
     @property
     def total_chips(self) -> int:
         return self.num_workers * self.chips_per_worker
+
+
+@dataclass
+class NetworkSpec:
+    """Networking: create-a-network vs bring-your-own.
+
+    The core template builds the whole network layer (VPC + public/private
+    subnets + IGW/NAT, deeplearning.template:785-901); the private Mask
+    R-CNN variant instead takes MyVpcId/PrivateSubnetId parameters and
+    creates nothing (private-mask-rcnn-cfn.yaml, SURVEY C10).  ``create``
+    selects between the two; ``external_ips=False`` is the
+    AssociatePublicIpAddress:false analog (private-mask-rcnn-cfn.yaml:1248).
+    """
+
+    create: bool = True
+    network: str | None = None  # existing VPC name when create=False
+    subnetwork: str | None = None
+    external_ips: bool = False
+
+    def validate(self) -> None:
+        if not self.create and not (self.network and self.subnetwork):
+            raise ConfigError(
+                "network.create=false requires existing network and "
+                "subnetwork names (the MyVpcId/PrivateSubnetId analog); the "
+                "subnet must already route to the TPU and storage APIs"
+            )
+
+
+@dataclass
+class StagingSpec:
+    """Dataset/code staging — the S3 bucket choreography of SURVEY C8/C9.
+
+    ``bucket``/``prefix`` name the artifact store (prepare-s3-bucket.sh
+    uploads to s3://$S3_BUCKET/$S3_PREFIX); ``datasets``/``code`` list the
+    artifact names every worker fetches at boot (mask-rcnn-cfn.yaml:790-827
+    tar download+extract steps).  ``data_on_shared_storage`` is the
+    EFSServesData condition (mask-rcnn-cfn.yaml:226-228): True places
+    datasets on the shared mount once (marker-file guarded), False places
+    them on every worker's local disk.
+    """
+
+    bucket: str | None = None
+    prefix: str = "dlcfn"
+    datasets: list[str] = field(default_factory=list)
+    code: list[str] = field(default_factory=list)
+    data_on_shared_storage: bool = True
+
+    def validate(self) -> None:
+        if (self.datasets or self.code) and not self.bucket:
+            raise ConfigError("staging artifacts listed but no staging bucket set")
+
+
+@dataclass
+class SetupSpec:
+    """Per-node environment setup — the setup.sh analog (SURVEY C7):
+    pinned Python deps and arbitrary post-boot commands, plus the
+    ActivateCondaEnv-style auto-activation (mask-rcnn-cfn.yaml:199-221)."""
+
+    pip_packages: list[str] = field(default_factory=list)
+    commands: list[str] = field(default_factory=list)
+    activate_env: str | None = None  # venv path auto-activated in login shells
+
+    def validate(self) -> None:
+        for pkg in self.pip_packages:
+            if any(c in pkg for c in ";|&`$"):
+                raise ConfigError(f"suspicious pip package spec {pkg!r}")
 
 
 @dataclass
@@ -231,6 +307,9 @@ class ClusterSpec:
     zone: str | None = None
     pool: NodePool = field(default_factory=NodePool)
     storage: StorageSpec = field(default_factory=StorageSpec)
+    network: NetworkSpec = field(default_factory=NetworkSpec)
+    staging: StagingSpec = field(default_factory=StagingSpec)
+    setup: SetupSpec = field(default_factory=SetupSpec)
     timeouts: TimeoutSpec = field(default_factory=TimeoutSpec)
     job: JobSpec = field(default_factory=JobSpec)
     ssh_source_cidr: str = "0.0.0.0/0"  # SSHLocation analog (deeplearning.template:87-94)
@@ -247,6 +326,9 @@ class ClusterSpec:
             raise ConfigError("gcp backend requires project and zone")
         self.pool.validate()
         self.storage.validate()
+        self.network.validate()
+        self.staging.validate()
+        self.setup.validate()
         self.timeouts.validate()
         self.job.validate(self.pool)
         return self
@@ -265,6 +347,12 @@ class ClusterSpec:
             d["pool"] = NodePool(**d["pool"])
         if "storage" in d and isinstance(d["storage"], dict):
             d["storage"] = StorageSpec(**d["storage"])
+        if "network" in d and isinstance(d["network"], dict):
+            d["network"] = NetworkSpec(**d["network"])
+        if "staging" in d and isinstance(d["staging"], dict):
+            d["staging"] = StagingSpec(**d["staging"])
+        if "setup" in d and isinstance(d["setup"], dict):
+            d["setup"] = SetupSpec(**d["setup"])
         if "timeouts" in d and isinstance(d["timeouts"], dict):
             d["timeouts"] = TimeoutSpec(**d["timeouts"])
         if "job" in d and isinstance(d["job"], dict):

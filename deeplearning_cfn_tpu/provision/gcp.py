@@ -67,6 +67,19 @@ class GCPBackend(Backend):
     broker_host: str | None = None  # coordinator VM running dlcfn-broker
     broker_port: int = 8477
     clock: Clock = field(default_factory=MonotonicClock)
+    # Networking (SURVEY C10): None network/subnetwork = the default network
+    # (create path); explicit names = bring-your-own private subnet.
+    network: str | None = None
+    subnetwork: str | None = None
+    external_ips: bool = False
+    # Boot disk sizing — the EBS volume params analog
+    # (mask-rcnn-cfn.yaml:54-73).
+    disk_size_gb: int = 100
+    disk_type: str = "pd-balanced"
+    spot: bool = False
+    # Full worker boot script (cluster/startup.py); falls back to the bare
+    # agent exec when not supplied.
+    startup_script: str | None = None
 
     def __post_init__(self) -> None:
         self.events = EventBus()
@@ -113,13 +126,30 @@ class GCPBackend(Backend):
                             "node": {
                                 "acceleratorType": self.accelerator_type,
                                 "runtimeVersion": self.runtime_version,
-                                "networkConfig": {"enableExternalIps": False},
-                                "schedulingConfig": {"preemptible": False},
+                                "networkConfig": {
+                                    "enableExternalIps": self.external_ips,
+                                    **(
+                                        {"network": self.network}
+                                        if self.network
+                                        else {}
+                                    ),
+                                    **(
+                                        {"subnetwork": self.subnetwork}
+                                        if self.subnetwork
+                                        else {}
+                                    ),
+                                },
+                                "schedulingConfig": {"preemptible": self.spot},
+                                "bootDiskConfig": {
+                                    "diskSizeGb": self.disk_size_gb,
+                                    "diskType": self.disk_type,
+                                },
                                 "metadata": {
                                     # The UserData/cfn-init analog: every
-                                    # worker boots the same bootstrap agent
+                                    # worker boots the same startup script
                                     # (deeplearning.template:490-516).
-                                    "startup-script": "python -m deeplearning_cfn_tpu.cluster.agent_main",
+                                    "startup-script": self.startup_script
+                                    or "python -m deeplearning_cfn_tpu.cluster.agent_main",
                                 },
                             },
                         }

@@ -1,0 +1,206 @@
+"""Tests for the C7/C8/C9/C10 surface: extended templates, startup-script
+rendering (cfn-init configSet analog), the object-store staging tool, and
+network spec validation."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from deeplearning_cfn_tpu.cluster.startup import DATA_MARKER, render_startup_script
+from deeplearning_cfn_tpu.config.schema import (
+    ClusterSpec,
+    ConfigError,
+    NetworkSpec,
+    SetupSpec,
+    StagingSpec,
+)
+from deeplearning_cfn_tpu.config.template import render_template_file
+from deeplearning_cfn_tpu.provision.objectstore import LocalObjectStore, Stager
+
+TEMPLATES = Path(__file__).resolve().parent.parent / "templates"
+
+
+class TestExtendedTemplates:
+    def test_detection_template_renders(self):
+        spec = render_template_file(
+            TEMPLATES / "detection-cluster.json",
+            {"Project": "p", "StagingBucket": "my-artifacts", "ActivateEnv": "/opt/venv"},
+        )
+        assert spec.staging.bucket == "my-artifacts"
+        assert spec.staging.datasets == ["coco2017.tar", "backbone-r50.tar"]
+        assert spec.setup.activate_env == "/opt/venv"
+        assert spec.timeouts.cluster_ready_s == 3600.0
+        assert spec.job.require_even_workers
+        assert spec.pool.disk_size_gb == 200
+        # Linear-scaling contract preserved (run.sh:56,66)
+        assert spec.job.steps_per_epoch_numerator == 120000
+
+    def test_detection_template_no_staging(self):
+        spec = render_template_file(
+            TEMPLATES / "detection-cluster.json", {"Project": "p"}
+        )
+        assert spec.staging.bucket is None
+        assert spec.staging.datasets == []
+
+    def test_runtime_override_analog(self):
+        spec = render_template_file(
+            TEMPLATES / "detection-cluster.json",
+            {"Project": "p", "RuntimeOverride": "tpu-custom-image"},
+        )
+        assert spec.pool.image_override == "tpu-custom-image"
+
+    def test_private_template_requires_network_params(self):
+        with pytest.raises(ConfigError, match="Network"):
+            render_template_file(
+                TEMPLATES / "detection-cluster-private.json", {"Project": "p"}
+            )
+
+    def test_private_template_brings_own_network(self):
+        spec = render_template_file(
+            TEMPLATES / "detection-cluster-private.json",
+            {"Project": "p", "Network": "corp-vpc", "Subnetwork": "ml-subnet"},
+        )
+        assert not spec.network.create
+        assert spec.network.network == "corp-vpc"
+        assert not spec.network.external_ips
+
+
+class TestNetworkSpec:
+    def test_byo_requires_names(self):
+        with pytest.raises(ConfigError, match="create=false"):
+            NetworkSpec(create=False).validate()
+
+    def test_create_needs_nothing(self):
+        NetworkSpec(create=True).validate()
+
+
+class TestStartupScript:
+    def _spec(self, **kw) -> ClusterSpec:
+        base = dict(name="det", backend="local")
+        base.update(kw)
+        return ClusterSpec(**base).validate()
+
+    def test_step_order_matches_configset(self):
+        # Setup = [storage-config, staging, env-setup, agent]
+        # (deeplearning.template:523 extended per mask-rcnn-cfn.yaml).
+        spec = self._spec(
+            staging=StagingSpec(bucket="b", datasets=["d.tar"], code=["c.tar"]),
+            setup=SetupSpec(pip_packages=["numpy==1.26.4"]),
+        )
+        script = render_startup_script(spec)
+        order = [
+            script.index("mkdir -p /mnt/dlcfn"),
+            script.index("gs://b/dlcfn/d.tar"),
+            script.index("pip install"),
+            script.index("agent_main"),
+        ]
+        assert order == sorted(order)
+        assert script.endswith("agent_main\n")
+
+    def test_shared_data_is_lock_elected_and_marker_guarded(self):
+        spec = self._spec(staging=StagingSpec(bucket="b", datasets=["d.tar"]))
+        script = render_startup_script(spec)
+        assert DATA_MARKER in script
+        # Atomic mkdir election; losers wait on the completion marker.
+        assert "if mkdir" in script
+        assert "sleep 10" in script
+
+    def test_local_data_not_marker_guarded(self):
+        spec = self._spec(
+            staging=StagingSpec(
+                bucket="b", datasets=["d.tar"], data_on_shared_storage=False
+            )
+        )
+        script = render_startup_script(spec)
+        assert DATA_MARKER not in script
+        assert "/mnt/disks/data" in script
+
+    def test_activate_env_written_to_login_shell(self):
+        spec = self._spec(setup=SetupSpec(activate_env="/opt/venv"))
+        script = render_startup_script(spec)
+        assert ".bash_login" in script
+
+    def test_staging_without_bucket_fails_validation(self):
+        with pytest.raises(ConfigError, match="bucket"):
+            self._spec(staging=StagingSpec(datasets=["d.tar"]))
+
+
+class TestStager:
+    def test_roundtrip(self, tmp_path):
+        store = LocalObjectStore(tmp_path / "bucket")
+        stager = Stager(store, prefix="pfx")
+        src = tmp_path / "dataset"
+        src.mkdir()
+        (src / "train.txt").write_text("hello")
+        art = stager.stage_path(src)
+        assert art.key == "pfx/dataset.tar"
+        assert store.exists(art.key)
+        out = stager.fetch_artifact("dataset.tar", tmp_path / "out")
+        assert (out / "dataset" / "train.txt").read_text() == "hello"
+
+    def test_missing_path_raises(self, tmp_path):
+        stager = Stager(LocalObjectStore(tmp_path))
+        with pytest.raises(FileNotFoundError):
+            stager.stage_path(tmp_path / "nope")
+
+    def test_key_escape_rejected(self, tmp_path):
+        store = LocalObjectStore(tmp_path / "bucket")
+        with pytest.raises(ValueError, match="escapes"):
+            store.put("../evil", b"x")
+
+
+class TestStageCLI:
+    def test_stage_local_backend(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DLCFN_ROOT", str(tmp_path / "root"))
+        template = {
+            "Parameters": {},
+            "Cluster": {
+                "name": "dev",
+                "backend": "local",
+                "pool": {"accelerator_type": "local-2", "workers": 2},
+                "storage": {"kind": "local"},
+                "staging": {"bucket": "artifacts", "prefix": "p"},
+            },
+        }
+        tpl = tmp_path / "t.json"
+        tpl.write_text(json.dumps(template))
+        data = tmp_path / "ds"
+        data.mkdir()
+        (data / "f").write_text("x")
+        proc = subprocess.run(
+            [sys.executable, "-m", "deeplearning_cfn_tpu.cli", "stage",
+             str(tpl), "--data", str(data)],
+            capture_output=True, text=True,
+            env={**__import__("os").environ, "DLCFN_ROOT": str(tmp_path / "root")},
+        )
+        assert proc.returncode == 0, proc.stderr
+        out = json.loads(proc.stdout)
+        assert out["artifacts"][0]["name"] == "ds.tar"
+        assert (tmp_path / "root" / "buckets" / "artifacts" / "p" / "ds.tar").is_file()
+
+    def test_stage_gcp_backend_fails_fast_before_tarring(self, tmp_path):
+        data = tmp_path / "ds"
+        data.mkdir()
+        proc = subprocess.run(
+            [sys.executable, "-m", "deeplearning_cfn_tpu.cli", "stage",
+             str(TEMPLATES / "detection-cluster.json"), "-P", "Project=p",
+             "-P", "StagingBucket=b", "--data", str(data)],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode != 0
+        assert "gsutil" in proc.stderr  # actionable message, not a traceback
+        assert "Traceback" not in proc.stderr
+
+    def test_startup_script_command(self, tmp_path):
+        proc = subprocess.run(
+            [sys.executable, "-m", "deeplearning_cfn_tpu.cli", "startup-script",
+             str(TEMPLATES / "detection-cluster.json"), "-P", "Project=p",
+             "-P", "StagingBucket=b"],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert proc.stdout.startswith("#!/bin/bash")
+        assert "agent_main" in proc.stdout
