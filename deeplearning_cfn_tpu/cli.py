@@ -150,24 +150,77 @@ def cmd_delete(args) -> int:
 
 
 def cmd_plan(args) -> int:
-    from deeplearning_cfn_tpu.cluster.contract import ClusterContract
-
     spec = _load_spec(args)
     # Render against a hypothetical full-size contract (no cloud calls).
-    ips = [f"10.0.0.{i + 2}" for i in range(spec.pool.num_workers)]
-    contract = ClusterContract.build(
-        cluster_name=spec.name,
-        coordinator_ip=ips[0],
-        other_worker_ips=ips[1:],
-        chips_per_worker=spec.pool.chips_per_worker,
-        storage_mount=spec.storage.mount_point,
-    )
+    contract = _hypothetical_contract(spec)
     plan = build_launch_plan(contract, spec.job)
     print(f"# job {plan.job_name}: NUM_PARALLEL={plan.num_parallel} "
           f"steps/epoch={plan.steps_per_epoch}")
     for w in plan.workers:
         print(f"# --- worker {w.process_id} ({w.host}) ---")
         print(plan.render_script(w.process_id))
+    return 0
+
+
+def _hypothetical_contract(spec: ClusterSpec):
+    """A full-size placeholder contract (10.0.0.x IPs) for rendering
+    plans/scripts without a live cluster."""
+    from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+
+    ips = [f"10.0.0.{i + 2}" for i in range(spec.pool.num_workers)]
+    return ClusterContract.build(
+        cluster_name=spec.name,
+        coordinator_ip=ips[0],
+        other_worker_ips=ips[1:],
+        chips_per_worker=spec.pool.chips_per_worker,
+        storage_mount=spec.storage.mount_point,
+    )
+
+
+def cmd_gen_scripts(args) -> int:
+    """Write one {host}.sh per worker to a shared dir — the
+    generate_trainer.py analog (its gen_scripts wrote per-host scripts to
+    EFS, generate_trainer.py:64-76); here each script carries the worker's
+    env (DLCFN_PROCESS_ID etc.) and the single SPMD command."""
+    from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+    from deeplearning_cfn_tpu.cluster.launcher import LaunchError
+
+    spec = _load_spec(args)
+    contract = None
+    try:
+        contract = ClusterContract.read()
+        if contract.cluster_name != spec.name:
+            print(
+                f"WARNING: live contract is for cluster "
+                f"{contract.cluster_name!r}, not {spec.name!r}; rendering "
+                "against a hypothetical full-size contract instead",
+                file=sys.stderr,
+            )
+            contract = None
+    except FileNotFoundError:
+        pass
+    if contract is None:
+        print(
+            "WARNING: no live cluster contract found; scripts use "
+            "placeholder 10.0.0.x addresses and are NOT deployable until "
+            "regenerated on a provisioned cluster",
+            file=sys.stderr,
+        )
+        contract = _hypothetical_contract(spec)
+    try:
+        plan = build_launch_plan(contract, spec.job)
+    except LaunchError as e:
+        print(f"GEN-SCRIPTS FAILED: {e}", file=sys.stderr)
+        return 1
+    out_dir = Path(args.out)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    written = []
+    for w in plan.workers:
+        path = out_dir / f"{w.host}.sh"
+        path.write_text(plan.render_script(w.process_id))
+        path.chmod(0o755)
+        written.append(str(path))
+    print(json.dumps({"scripts": written, "num_parallel": plan.num_parallel}))
     return 0
 
 
@@ -265,6 +318,7 @@ def main(argv: list[str] | None = None) -> int:
         ("run", cmd_run),
         ("startup-script", cmd_startup_script),
         ("stage", cmd_stage),
+        ("gen-scripts", cmd_gen_scripts),
     ]:
         p = sub.add_parser(name)
         p.add_argument("template", type=Path)
@@ -282,6 +336,9 @@ def main(argv: list[str] | None = None) -> int:
                            help="dataset file/dir to tar+upload (repeatable)")
             p.add_argument("--code", action="append", default=[],
                            help="code file/dir to tar+upload (repeatable)")
+        if name == "gen-scripts":
+            p.add_argument("--out", default=".",
+                           help="shared dir to write {host}.sh scripts into")
         p.set_defaults(fn=fn)
     args = parser.parse_args(argv)
     return args.fn(args)

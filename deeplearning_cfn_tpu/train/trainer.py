@@ -289,7 +289,11 @@ class Trainer:
         steps: int,
         logger: ThroughputLogger | None = None,
         checkpointer: Any = None,
+        stop_fn: Callable[[dict], bool] | None = None,
     ) -> tuple[TrainState, list[float]]:
+        """``stop_fn(metrics) -> True`` ends training early — the
+        time-to-accuracy mode (the reference's only published CIFAR metric
+        is 100-epochs-to-92%-accuracy, README.md:141)."""
         losses: list[float] = []
         step_fn = self.step_fn
         # Global step tracked host-side (syncing state.step every iteration
@@ -313,6 +317,8 @@ class Trainer:
                 logger.step(gstep, loss)
             if checkpointer is not None and checkpointer.should_save(gstep):
                 checkpointer.save(gstep, state)
+            if stop_fn is not None and stop_fn(metrics):
+                break
         return state, losses
 
     # --- compile diagnostics ---------------------------------------------

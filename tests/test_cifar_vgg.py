@@ -1,0 +1,135 @@
+"""CIFAR-10/VGG example + gen-scripts CLI tests (reference C4/C5/C12
+parity: generate_trainer.py per-host scripts, the CIFAR-10 walkthroughs)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_cfn_tpu.models.vgg import VGG, VGG11, CONFIGS
+
+TEMPLATES = Path(__file__).resolve().parent.parent / "templates"
+
+
+class TestVGG:
+    def test_output_shape_all_variants(self):
+        x = jnp.zeros((2, 32, 32, 3))
+        for name, config in CONFIGS.items():
+            model = VGG(config=config, num_classes=10)
+            variables = model.init(jax.random.key(0), x, train=False)
+            logits = model.apply(variables, x, train=False)
+            assert logits.shape == (2, 10), name
+
+    def test_vgg11_has_8_conv_layers(self):
+        model = VGG11(num_classes=10)
+        variables = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)
+        convs = [k for k in variables["params"] if k.startswith("conv")]
+        assert len(convs) == 8  # vgg11 = 8 conv + (3 fc, replaced by GAP head)
+
+    def test_bn_stats_in_f32(self):
+        model = VGG11(num_classes=10, dtype=jnp.bfloat16)
+        variables = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)
+        mean = variables["batch_stats"]["bn1"]["mean"]
+        assert mean.dtype == jnp.float32
+
+
+@pytest.mark.slow
+class TestCifarTraining:
+    def test_time_to_accuracy_run(self):
+        """One training run asserts both smoke properties: loss decreases
+        (SURVEY §4) and time-to-accuracy early stop fires (README.md:141 is
+        the reference's only published CIFAR number)."""
+        from deeplearning_cfn_tpu.examples import cifar10_train
+
+        out = cifar10_train.main(
+            ["--model", "vgg11", "--global_batch_size", "32", "--steps", "120",
+             "--learning_rate", "0.02", "--target_accuracy", "0.5",
+             "--log_every", "1"]
+        )
+        hist = out["history"]
+        first = np.mean([h["loss"] for h in hist[:3]])
+        last = np.mean([h["loss"] for h in hist[-3:]])
+        assert last < first, f"cifar10 loss did not decrease: {first} -> {last}"
+        # Early stop before the step budget at the accuracy target.
+        assert out["steps"] < 120
+        assert out["final_accuracy"] >= 0.5
+
+
+class TestGenScripts:
+    def test_writes_one_script_per_host(self, tmp_path):
+        template = {
+            "Parameters": {},
+            "Cluster": {
+                "name": "dev",
+                "backend": "local",
+                "pool": {"accelerator_type": "local-2", "workers": 3},
+                "storage": {"kind": "local"},
+                "job": {"global_batch_size": 30,
+                        "module": "deeplearning_cfn_tpu.examples.cifar10_train"},
+            },
+        }
+        tpl = tmp_path / "t.json"
+        tpl.write_text(json.dumps(template))
+        out_dir = tmp_path / "scripts"
+        import os
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "deeplearning_cfn_tpu.cli", "gen-scripts",
+             str(tpl), "--out", str(out_dir)],
+            capture_output=True, text=True,
+            # Hermetic: a real /opt/deeplearning/contract.json must not leak in.
+            env={**os.environ, "DLCFN_ROOT": str(tmp_path / "empty-root")},
+        )
+        assert proc.returncode == 0, proc.stderr
+        result = json.loads(proc.stdout)
+        assert len(result["scripts"]) == 3
+        master = (out_dir / "deeplearning-master.sh").read_text()
+        worker2 = (out_dir / "deeplearning-worker2.sh").read_text()
+        # Every script runs the same module with its own process id —
+        # the SPMD replacement for generate_trainer.py's ps/worker split.
+        assert "cifar10_train" in master and "cifar10_train" in worker2
+        assert "DLCFN_PROCESS_ID=0" in master
+        assert "DLCFN_PROCESS_ID=2" in worker2
+        # Placeholder-contract path must warn that scripts aren't deployable.
+        assert "WARNING" in proc.stderr
+
+    def test_wrong_cluster_contract_falls_back(self, tmp_path):
+        import os
+
+        from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+
+        root = tmp_path / "root"
+        ClusterContract.build(
+            cluster_name="other-cluster",
+            coordinator_ip="10.9.9.9",
+            other_worker_ips=["10.9.9.10"],
+            chips_per_worker=1,
+            storage_mount="/mnt/x",
+        ).write(root)
+        template = {
+            "Parameters": {},
+            "Cluster": {
+                "name": "dev",
+                "backend": "local",
+                "pool": {"accelerator_type": "local-2", "workers": 2},
+                "storage": {"kind": "local"},
+                "job": {"global_batch_size": 30},
+            },
+        }
+        tpl = tmp_path / "t.json"
+        tpl.write_text(json.dumps(template))
+        proc = subprocess.run(
+            [sys.executable, "-m", "deeplearning_cfn_tpu.cli", "gen-scripts",
+             str(tpl), "--out", str(tmp_path / "scripts")],
+            capture_output=True, text=True,
+            env={**os.environ, "DLCFN_ROOT": str(root)},
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "other-cluster" in proc.stderr  # mismatch warned, not silent
+        # Rendered against the template's own size, not the foreign contract.
+        assert len(json.loads(proc.stdout)["scripts"]) == 2
