@@ -189,19 +189,22 @@ def cmd_gen_scripts(args) -> int:
     contract = None
     try:
         contract = ClusterContract.read()
-        if contract.cluster_name != spec.name:
-            print(
-                f"WARNING: live contract is for cluster "
-                f"{contract.cluster_name!r}, not {spec.name!r}; rendering "
-                "against a hypothetical full-size contract instead",
-                file=sys.stderr,
-            )
-            contract = None
     except FileNotFoundError:
         pass
+    except (ValueError, TypeError, KeyError) as e:
+        # Corrupt or version-skewed contract.json (interrupted write, older
+        # schema): degrade to placeholders like the missing-file path.
+        print(f"WARNING: unreadable cluster contract ({e})", file=sys.stderr)
+    if contract is not None and contract.cluster_name != spec.name:
+        print(
+            f"WARNING: live contract is for cluster "
+            f"{contract.cluster_name!r}, not {spec.name!r}; ignoring it",
+            file=sys.stderr,
+        )
+        contract = None
     if contract is None:
         print(
-            "WARNING: no live cluster contract found; scripts use "
+            "WARNING: no usable cluster contract; scripts use "
             "placeholder 10.0.0.x addresses and are NOT deployable until "
             "regenerated on a provisioned cluster",
             file=sys.stderr,

@@ -64,6 +64,14 @@ def main(argv: list[str] | None = None) -> dict:
     )
     sample = next(iter(ds.batches(1)))
     state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
+    ckpt = None
+    if args.checkpoint_dir:
+        from deeplearning_cfn_tpu.train.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(args.checkpoint_dir)
+        restored = ckpt.restore_latest(state)
+        if restored is not None:
+            state, _ = restored
     logger = ThroughputLogger(
         global_batch_size=batch, log_every=args.log_every, name=args.model
     )
@@ -79,8 +87,11 @@ def main(argv: list[str] | None = None) -> dict:
 
     state, losses = trainer.fit(
         state, ds.batches(args.steps), steps=args.steps, logger=logger,
-        stop_fn=stop_fn,
+        stop_fn=stop_fn, checkpointer=ckpt,
     )
+    if ckpt:
+        ckpt.save(int(jax.device_get(state.step)), state)
+        ckpt.close()
     return {
         "final_loss": losses[-1],
         "final_accuracy": last_accuracy["value"],

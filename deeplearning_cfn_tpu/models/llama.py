@@ -63,6 +63,13 @@ class LlamaConfig:
     # Pallas flash-attention kernel (ops/pallas_attention.py) instead of XLA
     # attention: blockwise online softmax, never materializes [S, S] in HBM.
     use_flash_attention: bool = False
+    # Mixture-of-experts MLP (ops/moe.py): n_experts > 0 replaces the dense
+    # SwiGLU with a top-k routed expert bank sharded over the ``ep`` mesh
+    # axis.  0 = dense model.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -73,7 +80,7 @@ class LlamaConfig:
         return cls()  # defaults above are the 8B shape
 
     @classmethod
-    def tiny(cls, vocab_size: int = 256, seq_len: int = 128) -> "LlamaConfig":
+    def tiny(cls, vocab_size: int = 256, seq_len: int = 128, **kw) -> "LlamaConfig":
         return cls(
             vocab_size=vocab_size,
             dim=64,
@@ -84,6 +91,24 @@ class LlamaConfig:
             max_seq_len=seq_len,
             remat=False,
             tied_embeddings=True,
+            **kw,
+        )
+
+    @classmethod
+    def tiny_moe(cls, n_experts: int = 4, **kw) -> "LlamaConfig":
+        return cls.tiny(n_experts=n_experts, **kw)
+
+    @property
+    def moe(self) -> "MoEConfig | None":
+        if self.n_experts <= 0:
+            return None
+        from deeplearning_cfn_tpu.ops.moe import MoEConfig
+
+        return MoEConfig(
+            n_experts=self.n_experts,
+            top_k=self.moe_top_k,
+            capacity_factor=self.moe_capacity_factor,
+            aux_loss_weight=self.moe_aux_weight,
         )
 
 
@@ -101,19 +126,31 @@ def init_params(cfg: LlamaConfig, rng: jax.Array) -> dict:
             cfg.dtype
         )
 
+    layers: dict = {
+        "attn_norm": jnp.ones((L, d), jnp.float32),
+        "wq": dense_init(keys[1], (L, d, cfg.n_heads * hd), d),
+        "wk": dense_init(keys[2], (L, d, cfg.n_kv_heads * hd), d),
+        "wv": dense_init(keys[3], (L, d, cfg.n_kv_heads * hd), d),
+        "wo": dense_init(keys[4], (L, cfg.n_heads * hd, d), cfg.n_heads * hd),
+        "mlp_norm": jnp.ones((L, d), jnp.float32),
+    }
+    if cfg.moe is not None:
+        from deeplearning_cfn_tpu.ops.moe import init_moe_params
+
+        moe_keys = jax.random.split(keys[5], L)
+        stacked = [
+            init_moe_params(cfg.moe, mk, d, cfg.mlp_dim, cfg.dtype) for mk in moe_keys
+        ]
+        layers["moe"] = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *stacked
+        )
+    else:
+        layers["w_gate"] = dense_init(keys[5], (L, d, cfg.mlp_dim), d)
+        layers["w_up"] = dense_init(keys[6], (L, d, cfg.mlp_dim), d)
+        layers["w_down"] = dense_init(keys[7], (L, cfg.mlp_dim, d), cfg.mlp_dim)
     params = {
         "embed": dense_init(keys[0], (cfg.vocab_size, d), d),
-        "layers": {
-            "attn_norm": jnp.ones((L, d), jnp.float32),
-            "wq": dense_init(keys[1], (L, d, cfg.n_heads * hd), d),
-            "wk": dense_init(keys[2], (L, d, cfg.n_kv_heads * hd), d),
-            "wv": dense_init(keys[3], (L, d, cfg.n_kv_heads * hd), d),
-            "wo": dense_init(keys[4], (L, cfg.n_heads * hd, d), cfg.n_heads * hd),
-            "mlp_norm": jnp.ones((L, d), jnp.float32),
-            "w_gate": dense_init(keys[5], (L, d, cfg.mlp_dim), d),
-            "w_up": dense_init(keys[6], (L, d, cfg.mlp_dim), d),
-            "w_down": dense_init(keys[7], (L, cfg.mlp_dim, d), cfg.mlp_dim),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((d,), jnp.float32),
     }
     if not cfg.tied_embeddings:
@@ -125,19 +162,30 @@ def param_specs(cfg: LlamaConfig) -> dict:
     """PartitionSpec tree: FSDP shards the embed/hidden axis, TP shards
     heads/mlp/vocab — the standard 2D layout.  Layer axis (from scan
     stacking) is never sharded."""
+    layers: dict = {
+        "attn_norm": P(None, None),
+        "wq": P(None, "fsdp", "tp"),
+        "wk": P(None, "fsdp", "tp"),
+        "wv": P(None, "fsdp", "tp"),
+        "wo": P(None, "tp", "fsdp"),
+        "mlp_norm": P(None, None),
+    }
+    if cfg.moe is not None:
+        from deeplearning_cfn_tpu.ops.moe import moe_param_specs
+
+        # Prepend the stacked-layer axis to each per-expert spec.
+        layers["moe"] = jax.tree_util.tree_map(
+            lambda s: P(None, *s),
+            moe_param_specs(),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+    else:
+        layers["w_gate"] = P(None, "fsdp", "tp")
+        layers["w_up"] = P(None, "fsdp", "tp")
+        layers["w_down"] = P(None, "tp", "fsdp")
     specs = {
         "embed": P("tp", "fsdp"),
-        "layers": {
-            "attn_norm": P(None, None),
-            "wq": P(None, "fsdp", "tp"),
-            "wk": P(None, "fsdp", "tp"),
-            "wv": P(None, "fsdp", "tp"),
-            "wo": P(None, "tp", "fsdp"),
-            "mlp_norm": P(None, None),
-            "w_gate": P(None, "fsdp", "tp"),
-            "w_up": P(None, "fsdp", "tp"),
-            "w_down": P(None, "tp", "fsdp"),
-        },
+        "layers": layers,
         "final_norm": P(None),
     }
     if not cfg.tied_embeddings:
@@ -163,14 +211,7 @@ def param_count(cfg: LlamaConfig) -> int:
 
 # --- forward ------------------------------------------------------------
 
-def _maybe_shard(x: jax.Array, spec: P) -> jax.Array:
-    """Apply a sharding hint when a mesh context is available; no-op
-    otherwise (bare PartitionSpecs need a context mesh, and the forward
-    stays mesh-agnostic — the trainer sets the context)."""
-    mesh = jax.sharding.get_abstract_mesh()
-    if mesh is None or not mesh.axis_names:
-        return x
-    return jax.lax.with_sharding_constraint(x, spec)
+from deeplearning_cfn_tpu.parallel.sharding import maybe_shard as _maybe_shard
 
 def _block(
     cfg: LlamaConfig,
@@ -178,7 +219,9 @@ def _block(
     x: jax.Array,
     lp: dict,
     positions: jax.Array,
-) -> jax.Array:
+) -> tuple[jax.Array, jax.Array]:
+    """One decoder block: (x, aux_loss) — aux is the MoE load-balancing
+    loss, 0 for dense models."""
     B, S, d = x.shape
     hd = cfg.head_dim
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
@@ -201,15 +244,24 @@ def _block(
         attn = dot_product_attention(q, k, v, causal=True)
     x = x + attn.reshape(B, S, cfg.n_heads * hd) @ lp["wo"]
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    if cfg.moe is not None:
+        from deeplearning_cfn_tpu.ops.moe import moe_mlp
+
+        y, aux = moe_mlp(cfg.moe, lp["moe"], h)
+        return x + y, aux
     gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
     x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
-    return x
+    return x, jnp.zeros((), jnp.float32)
 
 
-def forward(
+def forward_with_aux(
     cfg: LlamaConfig, params: dict, tokens: jax.Array, mesh: Mesh | None = None
-) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, V] (f32)."""
+) -> tuple[jax.Array, jax.Array]:
+    """tokens [B, S] int32 -> (logits [B, S, V] f32, aux_loss scalar).
+
+    aux_loss is the summed MoE load-balancing loss over layers (0 for dense
+    configs) — added to the training objective, excluded from perplexity.
+    """
     B, S = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
     x = _maybe_shard(x, P(("dp", "fsdp"), "sp", None))
@@ -220,15 +272,25 @@ def forward(
         block = jax.checkpoint(block, static_argnums=())
 
     def scan_body(carry, lp):
-        return block(carry, lp, positions), None
+        x, aux_sum = carry
+        x, aux = block(x, lp, positions)
+        return (x, aux_sum + aux), None
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    (x, aux_sum), _ = jax.lax.scan(
+        scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"]
+    )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     if cfg.tied_embeddings:
         logits = x @ params["embed"].astype(cfg.dtype).T
     else:
         logits = x @ params["output"]
-    return logits.astype(jnp.float32)
+    return logits.astype(jnp.float32), aux_sum
+
+
+def forward(
+    cfg: LlamaConfig, params: dict, tokens: jax.Array, mesh: Mesh | None = None
+) -> jax.Array:
+    return forward_with_aux(cfg, params, tokens, mesh)[0]
 
 
 class _FunctionalInit:
@@ -266,10 +328,14 @@ def causal_lm_loss(
     mesh: Mesh | None = None,
 ) -> tuple[jax.Array, dict]:
     """Mean next-token cross-entropy; last position excluded (its rolled
-    target wraps to the sequence start)."""
-    logits = forward(cfg, params, tokens, mesh)
+    target wraps to the sequence start).  MoE configs add the router
+    load-balancing aux loss to the objective (not to perplexity)."""
+    logits, aux = forward_with_aux(cfg, params, tokens, mesh)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     mask = jnp.ones_like(nll).at[:, -1].set(0.0)
     loss = jnp.sum(nll * mask) / jnp.sum(mask)
-    return loss, {"perplexity": jnp.exp(loss)}
+    metrics = {"perplexity": jnp.exp(loss)}
+    if cfg.moe is not None:
+        metrics["moe_aux_loss"] = aux
+    return loss + aux, metrics

@@ -72,6 +72,16 @@ def infer_param_sharding(params: Any, mesh: Mesh) -> Any:
     )
 
 
+def maybe_shard(x: Any, spec: P) -> Any:
+    """Apply a with_sharding_constraint hint when a mesh context is active;
+    no-op otherwise.  Lets model code stay mesh-agnostic — the trainer sets
+    the context mesh (trainer.train_step)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or not mesh.axis_names:
+        return x
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
 def shard_pytree(tree: Any, shardings: Any) -> Any:
     """Place a host pytree onto devices with the given shardings."""
     return jax.tree_util.tree_map(lambda x, s: jax.device_put(x, s), tree, shardings)
