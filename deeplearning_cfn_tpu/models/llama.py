@@ -70,6 +70,35 @@ class LlamaConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # Pipeline parallelism (parallel/pipeline.py): pp_stages > 1 splits the
+    # decoder stack into stages sharded over the ``pp`` mesh axis and runs a
+    # GPipe microbatch schedule.  n_layers must divide evenly; ring
+    # attention (manual sp collectives) cannot nest inside the pipeline's
+    # shard_map region — dense/flash attention applies instead.
+    pp_stages: int = 1
+    # Microbatches per step when pipelining; 0 = pp_stages (minimum).  More
+    # microbatches shrink the (pp-1)/(M+pp-1) bubble at the cost of smaller
+    # per-tick matmuls.
+    pp_microbatches: int = 0
+
+    def __post_init__(self):
+        if self.n_experts > 0 and not (1 <= self.moe_top_k <= self.n_experts):
+            raise ValueError(
+                f"moe_top_k={self.moe_top_k} must be in [1, n_experts="
+                f"{self.n_experts}]"
+            )
+        if self.pp_stages > 1:
+            if self.n_layers % self.pp_stages:
+                raise ValueError(
+                    f"n_layers={self.n_layers} not divisible by "
+                    f"pp_stages={self.pp_stages}"
+                )
+            if self.use_ring_attention:
+                raise ValueError(
+                    "ring attention (manual sp collectives) cannot nest "
+                    "inside the pipeline shard_map region; use dense or "
+                    "flash attention with pp_stages > 1"
+                )
 
     @property
     def head_dim(self) -> int:
@@ -155,6 +184,11 @@ def init_params(cfg: LlamaConfig, rng: jax.Array) -> dict:
     }
     if not cfg.tied_embeddings:
         params["output"] = dense_init(keys[8], (d, cfg.vocab_size), d)
+    if cfg.pp_stages > 1:
+        from deeplearning_cfn_tpu.parallel.pipeline import stack_stages
+
+        # [L, ...] -> [pp, L/pp, ...]: the leading stage axis shards over pp.
+        params["layers"] = stack_stages(params["layers"], cfg.pp_stages)
     return params
 
 
@@ -183,6 +217,10 @@ def param_specs(cfg: LlamaConfig) -> dict:
         layers["w_gate"] = P(None, "fsdp", "tp")
         layers["w_up"] = P(None, "fsdp", "tp")
         layers["w_down"] = P(None, "tp", "fsdp")
+    if cfg.pp_stages > 1:
+        from deeplearning_cfn_tpu.parallel.pipeline import stage_specs
+
+        layers = stage_specs(layers)
     specs = {
         "embed": P("tp", "fsdp"),
         "layers": layers,
@@ -276,9 +314,35 @@ def forward_with_aux(
         x, aux = block(x, lp, positions)
         return (x, aux_sum + aux), None
 
-    (x, aux_sum), _ = jax.lax.scan(
-        scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"]
-    )
+    if cfg.pp_stages > 1 and mesh is not None and mesh.shape.get("pp", 1) > 1:
+        from deeplearning_cfn_tpu.parallel.pipeline import pipeline_apply
+
+        def stage_fn(stage_layers, act):
+            # One stage's L/pp layers, scanned exactly like the full stack.
+            (act, aux), _ = jax.lax.scan(
+                scan_body, (act, jnp.zeros((), jnp.float32)), stage_layers
+            )
+            return act, aux
+
+        x, aux_sum = pipeline_apply(
+            stage_fn,
+            params["layers"],
+            x,
+            mesh,
+            n_microbatches=cfg.pp_microbatches or cfg.pp_stages,
+        )
+    else:
+        layer_tree = params["layers"]
+        if cfg.pp_stages > 1:
+            # Stage-stacked params but no pp mesh axis (single-device runs):
+            # fold [pp, L/pp, ...] back to [L, ...] and scan sequentially.
+            layer_tree = jax.tree_util.tree_map(
+                lambda p: p.reshape(p.shape[0] * p.shape[1], *p.shape[2:]),
+                layer_tree,
+            )
+        (x, aux_sum), _ = jax.lax.scan(
+            scan_body, (x, jnp.zeros((), jnp.float32)), layer_tree
+        )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     if cfg.tied_embeddings:
         logits = x @ params["embed"].astype(cfg.dtype).T

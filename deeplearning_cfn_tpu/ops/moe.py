@@ -119,7 +119,12 @@ def moe_mlp(
     router_logits = (xt.astype(jnp.float32)) @ params["router"]  # [G, t, E]
     probs = jax.nn.softmax(router_logits, axis=-1)
     gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [G, t, k]
-    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+    if k > 1:
+        gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+    # k == 1 keeps the raw top-1 probability (Switch): normalizing would
+    # make the gate identically 1.0 — a constant with zero derivative
+    # w.r.t. the router logits, leaving the router trainable only through
+    # the aux loss.
 
     # Slot assignment with top-1 priority: within a group, experts fill
     # capacity from the k=0 choices of every token before any k=1 choice

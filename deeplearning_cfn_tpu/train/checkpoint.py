@@ -70,6 +70,12 @@ class Checkpointer:
 
     # --- io ---------------------------------------------------------------
     def save(self, step: int, state: Any) -> None:
+        """Idempotent per step: a final end-of-run save can coincide with a
+        step the in-loop policy already saved, and orbax raises
+        StepAlreadyExistsError on duplicates."""
+        if step in (self._manager.all_steps() or ()):
+            log.info("checkpoint for step %d already exists; skipping", step)
+            return
         self._manager.save(step, args=ocp.args.StandardSave(state))
         self._last_save_t = time.monotonic()
         log.info("checkpoint saved at step %d -> %s", step, self.directory)

@@ -83,3 +83,15 @@ def test_should_save_policies(tmp_path):
     assert ckpt2.should_save(1)  # interval elapsed immediately
     ckpt.close()
     ckpt2.close()
+
+
+def test_save_same_step_twice_is_idempotent(tmp_path):
+    """Regression: the end-of-run save may coincide with a step the in-loop
+    policy already saved; orbax would raise StepAlreadyExistsError."""
+    ckpt = Checkpointer(tmp_path / "dup", interval_s=None, async_save=False)
+    state = {"w": jnp.ones((2,), jnp.float32)}
+    ckpt.save(3, state)
+    ckpt.save(3, state)  # must not raise
+    restored, step = ckpt.restore_latest(state)
+    assert step == 3
+    ckpt.close()

@@ -91,3 +91,20 @@ def test_moe_llama_trains_on_ep_mesh():
         losses.append(float(metrics["loss"]))
     assert "moe_aux_loss" in metrics
     assert losses[-1] < losses[0], losses
+
+
+def test_top1_gate_passes_task_gradient_to_router():
+    """Regression: with top_k=1 the gate must be the raw top-1 probability
+    (Switch), not normalized to a constant 1.0 — otherwise the router only
+    ever learns from the aux loss."""
+    cfg = MoEConfig(n_experts=4, top_k=1, capacity_factor=2.0, aux_loss_weight=0.0)
+    params = init_moe_params(cfg, jax.random.key(0), 8, 16, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(1), (2, 8, 8), jnp.float32)
+
+    def task_loss(params):
+        y, _aux = moe_mlp(cfg, params, x)
+        return jnp.sum(y**2)
+
+    g = jax.grad(task_loss)(params)
+    router_grad_norm = float(jnp.linalg.norm(g["router"]))
+    assert router_grad_norm > 0.0, "router got no task gradient with top_k=1"
