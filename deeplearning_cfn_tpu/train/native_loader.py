@@ -1,0 +1,204 @@
+"""ctypes binding for the native data loader (native/dataloader).
+
+The hot input path: C++ reader threads pread fixed-size records straight
+into pooled batch buffers (record-level shuffle, per-worker sharding,
+bounded prefetch queue) while Python only hands finished buffers to
+``jax.device_put``.  This is the framework's native replacement for the
+loader work the reference outsourced to its external frameworks (SURVEY
+§2.2) — the accelerator never waits on per-example Python.
+
+Builds the shared library via make on first use (g++, same pattern as the
+rendezvous broker).  ``NativeRecordLoader.batches()`` yields
+:class:`~deeplearning_cfn_tpu.train.data.Batch`, so it drops into
+``Trainer.fit`` anywhere a synthetic dataset does.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from deeplearning_cfn_tpu.train.data import Batch
+from deeplearning_cfn_tpu.train.records import RecordSpec, read_header
+from deeplearning_cfn_tpu.utils.logging import get_logger
+
+log = get_logger("dlcfn.loader")
+
+LOADER_DIR = Path(__file__).resolve().parents[2] / "native" / "dataloader"
+LOADER_SO = LOADER_DIR / "libdlcfn_loader.so"
+
+_lib = None
+
+
+class LoaderError(RuntimeError):
+    pass
+
+
+def _build_library() -> None:
+    proc = subprocess.run(
+        ["make", "-C", str(LOADER_DIR)], capture_output=True, text=True
+    )
+    if proc.returncode != 0:
+        raise LoaderError(f"building native loader failed:\n{proc.stderr}")
+
+
+def _load_library() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not LOADER_SO.exists():
+        _build_library()
+    lib = ctypes.CDLL(str(LOADER_SO))
+    lib.dlcfn_loader_open.restype = ctypes.c_void_p
+    lib.dlcfn_loader_open.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.c_int,  # n_paths
+        ctypes.c_int,  # batch_size
+        ctypes.c_int,  # n_threads
+        ctypes.c_int,  # shard_index
+        ctypes.c_int,  # shard_count
+        ctypes.c_int,  # shuffle
+        ctypes.c_int,  # drop_remainder
+        ctypes.c_int,  # loop
+        ctypes.c_uint64,  # seed
+        ctypes.c_char_p,  # err_out
+        ctypes.c_int,  # err_cap
+    ]
+    lib.dlcfn_loader_record_size.restype = ctypes.c_uint32
+    lib.dlcfn_loader_record_size.argtypes = [ctypes.c_void_p]
+    lib.dlcfn_loader_shard_records.restype = ctypes.c_uint64
+    lib.dlcfn_loader_shard_records.argtypes = [ctypes.c_void_p]
+    lib.dlcfn_loader_batches_per_epoch.restype = ctypes.c_uint64
+    lib.dlcfn_loader_batches_per_epoch.argtypes = [ctypes.c_void_p]
+    lib.dlcfn_loader_next.restype = ctypes.c_int
+    lib.dlcfn_loader_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8)]
+    lib.dlcfn_loader_error.restype = ctypes.c_char_p
+    lib.dlcfn_loader_error.argtypes = [ctypes.c_void_p]
+    lib.dlcfn_loader_close.restype = None
+    lib.dlcfn_loader_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+@dataclass
+class NativeRecordLoader:
+    """Threaded shuffling reader over DLC1 files.
+
+    shard_index/shard_count partition records round-robin across SPMD
+    workers (each process reads only its shard, like the per-worker data
+    split the reference got from per-rank dataset sharding).
+    """
+
+    paths: Sequence[str | Path]
+    spec: RecordSpec
+    batch_size: int
+    n_threads: int = 4
+    shard_index: int = 0
+    shard_count: int = 1
+    shuffle: bool = True
+    drop_remainder: bool = True
+    loop: bool = True
+    seed: int = 0
+    _handle: int | None = field(default=None, repr=False)
+
+    def __post_init__(self) -> None:
+        if not self.paths:
+            raise LoaderError("no record files given")
+        for p in self.paths:
+            record_size, _ = read_header(p)
+            if record_size != self.spec.record_size:
+                raise LoaderError(
+                    f"{p}: record_size {record_size} != spec {self.spec.record_size}"
+                )
+        lib = _load_library()
+        c_paths = (ctypes.c_char_p * len(self.paths))(
+            *[str(p).encode() for p in self.paths]
+        )
+        err = ctypes.create_string_buffer(512)
+        handle = lib.dlcfn_loader_open(
+            c_paths,
+            len(self.paths),
+            self.batch_size,
+            self.n_threads,
+            self.shard_index,
+            self.shard_count,
+            int(self.shuffle),
+            int(self.drop_remainder),
+            int(self.loop),
+            self.seed,
+            err,
+            len(err),
+        )
+        if not handle:
+            raise LoaderError(err.value.decode() or "loader open failed")
+        self._handle = handle
+        self._buf = np.empty(
+            (self.batch_size, self.spec.record_size), dtype=np.uint8
+        )
+
+    def _live_handle(self) -> int:
+        if self._handle is None:
+            raise LoaderError("loader is closed")
+        return self._handle
+
+    # --- introspection ----------------------------------------------------
+    @property
+    def shard_records(self) -> int:
+        return int(_load_library().dlcfn_loader_shard_records(self._live_handle()))
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return int(
+            _load_library().dlcfn_loader_batches_per_epoch(self._live_handle())
+        )
+
+    # --- iteration --------------------------------------------------------
+    def next_raw(self, copy: bool = True) -> np.ndarray | None:
+        """[n, record_size] u8 for the next batch, or None at end of data.
+
+        With ``copy=False`` the returned array is a view into the loader's
+        single reuse buffer — valid only until the next ``next_raw`` call
+        (the next batch is memcpy'd over it).  Only use it when the bytes
+        are consumed (decoded / device_put) before the next call.
+        """
+        handle = self._live_handle()
+        lib = _load_library()
+        n = lib.dlcfn_loader_next(
+            handle,
+            self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        if n < 0:
+            raise LoaderError(lib.dlcfn_loader_error(handle).decode())
+        if n == 0:
+            return None
+        out = self._buf[:n]
+        return out.copy() if copy else out
+
+    def batches(self, steps: int | None = None) -> Iterator[Batch]:
+        """Yield decoded Batch objects (x, y fields of the spec)."""
+        i = 0
+        while steps is None or i < steps:
+            # copy=False: decode_batch copies field slices out of the reuse
+            # buffer before the next call can overwrite it.
+            raw = self.next_raw(copy=False)
+            if raw is None:
+                return
+            arrays = self.spec.decode_batch(raw)
+            yield Batch(x=arrays["x"], y=arrays["y"])
+            i += 1
+
+    def close(self) -> None:
+        if self._handle is not None:
+            _load_library().dlcfn_loader_close(self._handle)
+            self._handle = None
+
+    def __enter__(self) -> "NativeRecordLoader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,347 @@
+// dlcfn native data loader — multithreaded record-file reader.
+//
+// The input-pipeline throughput layer of the framework (the reference
+// delegates IO to its external frameworks' loaders; here host-side IO is
+// first-party native code so the accelerator never waits on Python).
+// Design, TPU-first:
+//
+//   - Fixed-size records (static shapes end-to-end: a batch is one
+//     contiguous buffer of batch_size * record_size bytes, ready for a
+//     single host->device transfer with no per-example Python work).
+//   - File format "DLC1": 4-byte magic, u32 record_size, u64 n_records,
+//     then n_records * record_size payload bytes.  Written by
+//     train/records.py, readable by offset arithmetic (pread), so shuffle
+//     is a permutation of the global record index space — true
+//     record-level shuffling without loading files whole.
+//   - Sharding: (shard_index, shard_count) partitions the global index
+//     space round-robin, matching per-worker data sharding in an SPMD job.
+//   - Threading: N worker threads claim batch tickets from an atomic
+//     counter, pread their records into a pooled buffer, and push the
+//     finished batch to a bounded ready-queue (condition variables both
+//     directions).  Batches may complete out of order; training does not
+//     care about batch order within an epoch.
+//
+// C ABI (ctypes-friendly), wrapped by deeplearning_cfn_tpu/train/native_loader.py.
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[4] = {'D', 'L', 'C', '1'};
+
+struct RecordFile {
+  std::string path;
+  int fd = -1;
+  uint32_t record_size = 0;
+  uint64_t n_records = 0;
+  uint64_t payload_offset = 0;
+};
+
+struct Batch {
+  std::vector<uint8_t> data;
+  uint32_t n_records = 0;
+};
+
+struct Loader {
+  std::vector<RecordFile> files;
+  uint32_t record_size = 0;
+  uint64_t total_records = 0;   // after sharding
+  std::vector<uint64_t> index;  // global record ids owned by this shard
+  uint32_t batch_size = 0;
+  bool drop_remainder = true;
+  bool shuffle = false;
+  bool loop = false;
+  uint64_t seed = 0;
+  uint64_t epoch = 0;
+
+  // file lookup: prefix[i] = first global record id of files[i]
+  std::vector<uint64_t> prefix;
+
+  // ticket dispenser + ready queue
+  std::atomic<uint64_t> next_ticket{0};
+  uint64_t n_batches_per_epoch = 0;
+
+  std::mutex mu;
+  std::condition_variable cv_ready;   // consumer waits: a batch is ready
+  std::condition_variable cv_space;   // producers wait: queue has space
+  std::deque<Batch> ready;
+  size_t max_ready = 4;
+  uint64_t batches_emitted_this_epoch = 0;
+  int live_threads = 0;  // workers still producing (guarded by mu)
+  bool stopping = false;
+  std::string error;
+
+  std::vector<std::thread> threads;
+};
+
+bool open_file(const std::string& path, RecordFile* rf, std::string* err) {
+  int fd = ::open(path.c_str(), O_RDONLY);
+  if (fd < 0) {
+    *err = "cannot open " + path;
+    return false;
+  }
+  uint8_t header[16];
+  ssize_t got = ::pread(fd, header, sizeof(header), 0);
+  if (got != (ssize_t)sizeof(header) || memcmp(header, kMagic, 4) != 0) {
+    *err = "bad DLC1 header in " + path;
+    ::close(fd);
+    return false;
+  }
+  uint32_t rs;
+  uint64_t n;
+  memcpy(&rs, header + 4, 4);
+  memcpy(&n, header + 8, 8);
+  rf->path = path;
+  rf->fd = fd;
+  rf->record_size = rs;
+  rf->n_records = n;
+  rf->payload_offset = sizeof(header);
+  return true;
+}
+
+// Map a global record id to (file, offset) and pread it into dst.
+bool read_record(Loader* L, uint64_t gid, uint8_t* dst) {
+  // binary search over prefix sums
+  size_t lo = 0, hi = L->files.size();
+  while (lo + 1 < hi) {
+    size_t mid = (lo + hi) / 2;
+    if (L->prefix[mid] <= gid) lo = mid; else hi = mid;
+  }
+  const RecordFile& f = L->files[lo];
+  uint64_t local = gid - L->prefix[lo];
+  off_t off = (off_t)(f.payload_offset + local * (uint64_t)L->record_size);
+  size_t want = L->record_size;
+  uint8_t* p = dst;
+  while (want > 0) {
+    ssize_t got = ::pread(f.fd, p, want, off);
+    if (got <= 0) return false;
+    p += got;
+    off += got;
+    want -= (size_t)got;
+  }
+  return true;
+}
+
+void reshuffle(Loader* L) {
+  if (!L->shuffle) return;
+  std::mt19937_64 rng(L->seed + 0x9e3779b97f4a7c15ULL * (L->epoch + 1));
+  std::shuffle(L->index.begin(), L->index.end(), rng);
+}
+
+// Decrements live_threads and wakes the consumer on every worker exit path.
+struct WorkerExit {
+  Loader* L;
+  ~WorkerExit() {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->live_threads--;
+    L->cv_ready.notify_all();
+  }
+};
+
+void worker_main(Loader* L) {
+  WorkerExit on_exit{L};
+  std::vector<uint8_t> buf;
+  for (;;) {
+    uint64_t ticket = L->next_ticket.fetch_add(1);
+    uint64_t epoch_ticket = ticket % L->n_batches_per_epoch;
+    uint64_t epoch = ticket / L->n_batches_per_epoch;
+    {
+      std::unique_lock<std::mutex> lk(L->mu);
+      if (L->stopping) return;
+      if (!L->loop && epoch >= 1) return;  // single epoch exhausted
+      // Wait for the epoch boundary: all of epoch e must be emitted
+      // before tickets of epoch e+1 are filled (the permutation changes).
+      while (!L->stopping && epoch > L->epoch) L->cv_space.wait(lk);
+      if (L->stopping) return;
+    }
+    uint64_t start = epoch_ticket * (uint64_t)L->batch_size;
+    uint64_t end = start + L->batch_size;
+    uint32_t n = L->batch_size;
+    if (end > L->index.size()) {  // remainder batch (drop_remainder=false)
+      n = (uint32_t)(L->index.size() - start);
+      end = L->index.size();
+    }
+    buf.assign((size_t)L->batch_size * L->record_size, 0);
+    bool ok = true;
+    for (uint64_t i = start; i < end; i++) {
+      if (!read_record(L, L->index[i], buf.data() + (i - start) * L->record_size)) {
+        ok = false;
+        break;
+      }
+    }
+    std::unique_lock<std::mutex> lk(L->mu);
+    if (!ok) {
+      L->error = "short read";
+      L->stopping = true;
+      L->cv_ready.notify_all();
+      L->cv_space.notify_all();
+      return;
+    }
+    while (!L->stopping && L->ready.size() >= L->max_ready)
+      L->cv_space.wait(lk);
+    if (L->stopping) return;
+    Batch b;
+    b.data = std::move(buf);
+    b.n_records = n;
+    L->ready.push_back(std::move(b));
+    L->batches_emitted_this_epoch++;
+    if (L->batches_emitted_this_epoch == L->n_batches_per_epoch) {
+      // epoch complete: advance permutation and release epoch+1 tickets
+      L->batches_emitted_this_epoch = 0;
+      L->epoch++;
+      reshuffle(L);
+      L->cv_space.notify_all();
+    }
+    L->cv_ready.notify_one();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle or null. paths: n null-terminated strings.
+void* dlcfn_loader_open(const char** paths, int n_paths, int batch_size,
+                        int n_threads, int shard_index, int shard_count,
+                        int shuffle, int drop_remainder, int loop,
+                        uint64_t seed, char* err_out, int err_cap) {
+  auto fail = [&](const std::string& msg) -> void* {
+    if (err_out && err_cap > 0) {
+      snprintf(err_out, err_cap, "%s", msg.c_str());
+    }
+    return nullptr;
+  };
+  if (n_paths <= 0 || batch_size <= 0 || shard_count <= 0 ||
+      shard_index < 0 || shard_index >= shard_count) {
+    return fail("invalid arguments");
+  }
+  auto* L = new Loader();
+  L->batch_size = (uint32_t)batch_size;
+  L->shuffle = shuffle != 0;
+  L->drop_remainder = drop_remainder != 0;
+  L->loop = loop != 0;
+  L->seed = seed;
+  uint64_t total = 0;
+  for (int i = 0; i < n_paths; i++) {
+    RecordFile rf;
+    std::string err;
+    if (!open_file(paths[i], &rf, &err)) {
+      for (auto& f : L->files) ::close(f.fd);
+      delete L;
+      return fail(err);
+    }
+    if (L->record_size == 0) L->record_size = rf.record_size;
+    if (rf.record_size != L->record_size) {
+      for (auto& f : L->files) ::close(f.fd);
+      ::close(rf.fd);
+      delete L;
+      return fail("record_size mismatch across files");
+    }
+    L->prefix.push_back(total);
+    total += rf.n_records;
+    L->files.push_back(rf);
+  }
+  // Shard the global index space round-robin.
+  for (uint64_t g = (uint64_t)shard_index; g < total; g += shard_count)
+    L->index.push_back(g);
+  L->total_records = L->index.size();
+  if (L->total_records == 0) {
+    for (auto& f : L->files) ::close(f.fd);
+    delete L;
+    return fail("shard owns zero records");
+  }
+  if (L->drop_remainder) {
+    L->n_batches_per_epoch = L->total_records / L->batch_size;
+    if (L->n_batches_per_epoch == 0) {
+      for (auto& f : L->files) ::close(f.fd);
+      delete L;
+      return fail("fewer records than one batch (drop_remainder)");
+    }
+    // The index is NOT truncated: each epoch permutes the full shard and
+    // tickets cover only the first n_batches*batch_size entries, so a
+    // DIFFERENT random remainder is dropped per epoch (truncating here
+    // would permanently exclude the same tail records from training).
+  } else {
+    L->n_batches_per_epoch =
+        (L->total_records + L->batch_size - 1) / L->batch_size;
+  }
+  L->epoch = 0;
+  reshuffle(L);
+  if (n_threads < 1) n_threads = 1;
+  L->max_ready = (size_t)std::max(4, n_threads * 2);
+  L->live_threads = n_threads;
+  for (int i = 0; i < n_threads; i++)
+    L->threads.emplace_back(worker_main, L);
+  return L;
+}
+
+uint32_t dlcfn_loader_record_size(void* h) {
+  return ((Loader*)h)->record_size;
+}
+
+uint64_t dlcfn_loader_shard_records(void* h) {
+  return ((Loader*)h)->total_records;
+}
+
+uint64_t dlcfn_loader_batches_per_epoch(void* h) {
+  return ((Loader*)h)->n_batches_per_epoch;
+}
+
+// Copies the next ready batch into out (capacity batch_size*record_size).
+// Returns number of records in the batch; 0 = end of (non-loop) data;
+// -1 = error (message via dlcfn_loader_error).
+int dlcfn_loader_next(void* h, uint8_t* out) {
+  auto* L = (Loader*)h;
+  std::unique_lock<std::mutex> lk(L->mu);
+  for (;;) {
+    if (!L->ready.empty()) {
+      Batch b = std::move(L->ready.front());
+      L->ready.pop_front();
+      lk.unlock();
+      memcpy(out, b.data.data(), b.data.size());
+      lk.lock();
+      L->cv_space.notify_all();
+      return (int)b.n_records;
+    }
+    if (!L->error.empty()) return -1;
+    if (L->stopping) return 0;
+    // Single-epoch mode: workers exit after the last epoch-0 ticket, so
+    // empty queue + no live producers means the data is exhausted.
+    if (L->live_threads == 0) return 0;
+    L->cv_ready.wait(lk);
+  }
+}
+
+const char* dlcfn_loader_error(void* h) {
+  return ((Loader*)h)->error.c_str();
+}
+
+void dlcfn_loader_close(void* h) {
+  auto* L = (Loader*)h;
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->stopping = true;
+  }
+  L->cv_ready.notify_all();
+  L->cv_space.notify_all();
+  for (auto& t : L->threads)
+    if (t.joinable()) t.join();
+  for (auto& f : L->files) ::close(f.fd);
+  delete L;
+}
+
+}  // extern "C"

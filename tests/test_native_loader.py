@@ -1,0 +1,187 @@
+"""Native data loader (native/dataloader + train/records + train/native_loader).
+
+The loader is concurrent C++; the tests assert the properties threading
+could silently break: exactly-once coverage per epoch, shard disjointness,
+deterministic-seed shuffle, and clean end-of-data/termination behavior.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning_cfn_tpu.train.data import SyntheticDataset
+from deeplearning_cfn_tpu.train.native_loader import LoaderError, NativeRecordLoader
+from deeplearning_cfn_tpu.train.records import (
+    Field,
+    RecordFormatError,
+    RecordSpec,
+    read_all,
+    read_header,
+    write_dataset,
+    write_records,
+)
+
+SPEC = RecordSpec((Field("x", "float32", (4,)), Field("y", "int32", ())))
+
+
+def _write(tmp_path, name, ids):
+    """Records whose x encodes the record id (coverage tracking)."""
+    recs = [
+        SPEC.encode(x=np.full((4,), i, np.float32), y=np.int32(i)) for i in ids
+    ]
+    path = tmp_path / name
+    write_records(path, SPEC, recs)
+    return path
+
+
+def test_roundtrip_and_header(tmp_path):
+    path = _write(tmp_path, "a.dlc", range(10))
+    record_size, n = read_header(path)
+    assert (record_size, n) == (SPEC.record_size, 10)
+    data = read_all(path, SPEC)
+    np.testing.assert_array_equal(data["y"], np.arange(10))
+    np.testing.assert_array_equal(data["x"][:, 0], np.arange(10, dtype=np.float32))
+
+
+def test_writer_validates_record_size(tmp_path):
+    with pytest.raises(RecordFormatError):
+        write_records(tmp_path / "bad.dlc", SPEC, [b"short"])
+
+
+def test_single_epoch_exactly_once(tmp_path):
+    paths = [_write(tmp_path, "a.dlc", range(0, 13)), _write(tmp_path, "b.dlc", range(13, 29))]
+    with NativeRecordLoader(
+        paths, SPEC, batch_size=4, n_threads=3, shuffle=True,
+        drop_remainder=False, loop=False,
+    ) as loader:
+        seen = []
+        for batch in loader.batches():
+            seen.extend(batch.y.tolist())
+        assert sorted(seen) == list(range(29))  # every record exactly once
+
+
+def test_drop_remainder_and_batches_per_epoch(tmp_path):
+    path = _write(tmp_path, "a.dlc", range(10))
+    with NativeRecordLoader(
+        [path], SPEC, batch_size=4, shuffle=False, drop_remainder=True, loop=False
+    ) as loader:
+        assert loader.batches_per_epoch == 2
+        batches = list(loader.batches())
+        assert len(batches) == 2
+        assert all(b.x.shape == (4, 4) for b in batches)
+
+
+def test_sharding_is_disjoint_and_covering(tmp_path):
+    path = _write(tmp_path, "a.dlc", range(20))
+    seen = []
+    for shard in range(2):
+        with NativeRecordLoader(
+            [path], SPEC, batch_size=2, shard_index=shard, shard_count=2,
+            shuffle=False, drop_remainder=False, loop=False,
+        ) as loader:
+            ids = [int(y) for b in loader.batches() for y in b.y]
+            assert len(ids) == 10
+            seen.append(set(ids))
+    assert seen[0].isdisjoint(seen[1])
+    assert seen[0] | seen[1] == set(range(20))
+
+
+def test_shuffle_is_seeded_and_reshuffles_across_epochs(tmp_path):
+    path = _write(tmp_path, "a.dlc", range(64))
+
+    def epoch_order(seed):
+        with NativeRecordLoader(
+            [path], SPEC, batch_size=64, n_threads=1, shuffle=True,
+            loop=True, seed=seed,
+        ) as loader:
+            first = [int(y) for y in next(loader.batches(1)).y]
+            second = [int(y) for y in next(loader.batches(1)).y]
+        return first, second
+
+    a1, a2 = epoch_order(7)
+    b1, _ = epoch_order(7)
+    assert a1 == b1  # same seed -> same permutation
+    assert a1 != a2  # epoch 1 reshuffled
+    assert sorted(a1) == sorted(a2) == list(range(64))
+
+
+def test_loop_mode_streams_beyond_one_epoch(tmp_path):
+    path = _write(tmp_path, "a.dlc", range(8))
+    with NativeRecordLoader(
+        [path], SPEC, batch_size=4, n_threads=2, shuffle=False, loop=True
+    ) as loader:
+        batches = list(loader.batches(10))  # 5 epochs worth
+        assert len(batches) == 10
+
+
+def test_record_size_mismatch_rejected(tmp_path):
+    path = _write(tmp_path, "a.dlc", range(4))
+    other = RecordSpec((Field("x", "float32", (8,)),))
+    with pytest.raises(LoaderError, match="record_size"):
+        NativeRecordLoader([path], other, batch_size=2)
+
+
+def test_write_dataset_then_train(tmp_path):
+    """Staging a synthetic dataset to records and training from the native
+    loader reproduces the e2e smoke: loss decreases."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning_cfn_tpu.models.lenet import LeNet
+    from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
+    from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
+
+    ds = SyntheticDataset(shape=(8, 8, 1), num_classes=4, batch_size=16)
+    spec = RecordSpec.classification((8, 8, 1))
+    path = tmp_path / "train.dlc"
+    n = write_dataset(path, spec, ds.batches(8), steps=8)
+    assert n == 128
+
+    mesh = build_mesh(MeshSpec.data_parallel(8), jax.devices()[:8])
+    trainer = Trainer(
+        LeNet(num_classes=4), mesh,
+        TrainerConfig(learning_rate=0.05, matmul_precision="float32"),
+    )
+    with NativeRecordLoader([path], spec, batch_size=16, loop=True) as loader:
+        batches = loader.batches(30)
+        first = next(batches)
+        state = trainer.init(jax.random.key(0), jnp.asarray(first.x))
+        state, losses = trainer.fit(state, batches, steps=29)
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_drop_remainder_rotates_across_epochs(tmp_path):
+    """Regression: with shuffle on, a DIFFERENT random remainder must drop
+    each epoch — truncating the index at open time would permanently
+    exclude the same records from training."""
+    path = _write(tmp_path, "a.dlc", range(10))  # batch 4 -> 2 records dropped
+    with NativeRecordLoader(
+        [path], SPEC, batch_size=4, n_threads=1, shuffle=True,
+        drop_remainder=True, loop=True, seed=3,
+    ) as loader:
+        seen = set()
+        for batch in loader.batches(2 * 8):  # 8 epochs of 2 batches
+            seen.update(int(y) for y in batch.y)
+    assert seen == set(range(10)), f"records never trained on: {set(range(10)) - seen}"
+
+
+def test_next_raw_copies_by_default(tmp_path):
+    path = _write(tmp_path, "a.dlc", range(8))
+    with NativeRecordLoader(
+        [path], SPEC, batch_size=4, n_threads=1, shuffle=False, loop=True
+    ) as loader:
+        first = loader.next_raw()
+        snapshot = first.copy()
+        loader.next_raw()  # would overwrite a view into the reuse buffer
+        np.testing.assert_array_equal(first, snapshot)
+
+
+def test_closed_loader_raises_not_segfaults(tmp_path):
+    path = _write(tmp_path, "a.dlc", range(8))
+    loader = NativeRecordLoader([path], SPEC, batch_size=4)
+    loader.close()
+    with pytest.raises(LoaderError, match="closed"):
+        _ = loader.shard_records
+    with pytest.raises(LoaderError, match="closed"):
+        _ = loader.batches_per_epoch
+    with pytest.raises(LoaderError, match="closed"):
+        loader.next_raw()
