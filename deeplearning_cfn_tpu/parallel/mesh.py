@@ -118,6 +118,75 @@ class AutoLayout:
         return MeshSpec(fsdp=self.n_devices // tp, tp=tp)
 
 
+def build_hybrid_mesh(
+    ici_spec: MeshSpec, dcn_spec: MeshSpec, devices: list | None = None
+) -> Mesh:
+    """Multi-slice mesh: ICI axes within a slice x DCN axes across slices.
+
+    The reference scales across nodes by adding hosts to the worker ASG and
+    letting NCCL ring over VPC TCP (SURVEY §2.4).  The TPU equivalent is
+    explicit in the topology: each slice is an ICI domain; slices are
+    joined over DCN, and only infrequent-communication axes (dp / fsdp /
+    pp — gradient reduction once per step, pipeline hops) may span it.
+    tp/sp/ep exchange activations inside every layer and would serialize
+    on DCN latency, so placing them across slices is rejected.
+
+    Per mesh axis, size = dcn * ici with the DCN component varying slowest,
+    so e.g. ici fsdp=4 x dcn dp=2 gives the standard "FSDP inside the
+    slice, data-parallel across slices" layout.
+
+    On real multi-slice hardware (devices carrying ``slice_index``) the
+    grid comes from ``mesh_utils.create_hybrid_device_mesh`` so DCN axes
+    align with slice boundaries; single-granule device sets (CPU meshes in
+    tests, single-slice dry runs) fall back to a deterministic reshape
+    with the same axis semantics.
+    """
+    for axis in ("sp", "tp", "ep"):
+        if dcn_spec.axis_sizes()[axis] > 1:
+            raise MeshError(
+                f"axis {axis!r} exchanges activations every layer and "
+                "cannot span DCN; put it in the ICI spec"
+            )
+    devices = list(devices if devices is not None else jax.devices())
+    for name, spec in (("ici", ici_spec), ("dcn", dcn_spec)):
+        for axis, size in spec.axis_sizes().items():
+            if size < 1:
+                raise MeshError(f"{name} axis {axis} must be >= 1, got {size}")
+    MeshSpec(
+        **{
+            a: ici_spec.axis_sizes()[a] * dcn_spec.axis_sizes()[a]
+            for a in AXIS_ORDER
+        }
+    ).validate(len(devices))
+    ici_shape = [ici_spec.axis_sizes()[a] for a in AXIS_ORDER]
+    dcn_shape = [dcn_spec.axis_sizes()[a] for a in AXIS_ORDER]
+    # Granule = what create_hybrid_device_mesh will group by: slice_index
+    # when the platform exposes it, else whole processes.
+    has_slice = all(hasattr(d, "slice_index") for d in devices)
+    granules = {
+        d.slice_index if has_slice else getattr(d, "process_index", 0)
+        for d in devices
+    }
+    if len(granules) > 1:
+        from jax.experimental import mesh_utils
+
+        grid = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices,
+            process_is_granule=not has_slice,
+            allow_split_physical_axes=True,
+        )
+    else:
+        # Single granule: [dcn axes..., ici axes...] then interleave per
+        # axis so each combined axis is (dcn, ici) with dcn slowest.
+        n_axes = len(AXIS_ORDER)
+        grid = np.array(devices).reshape(*dcn_shape, *ici_shape)
+        order = [i + off for i in range(n_axes) for off in (0, n_axes)]
+        grid = grid.transpose(order).reshape(
+            *(d * i for d, i in zip(dcn_shape, ici_shape))
+        )
+    return Mesh(grid, AXIS_ORDER)
+
+
 def virtual_cpu_devices(n: int) -> list:
     """Devices for an n-way virtual mesh on CPU (tests / dry runs).
 
