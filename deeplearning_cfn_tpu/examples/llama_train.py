@@ -30,14 +30,18 @@ def main(argv: list[str] | None = None) -> dict:
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--ring_attention", action="store_true")
+    p.add_argument("--pp", type=int, default=1, help="pipeline stages (GPipe)")
+    p.add_argument("--pp_microbatches", type=int, default=0)
+    p.add_argument("--experts", type=int, default=0, help="MoE experts (0 = dense)")
+    p.add_argument("--ep", type=int, default=1, help="expert-parallel axis size")
     args = p.parse_args(argv)
     maybe_init_distributed()
 
     n = len(jax.devices())
-    tp, sp = args.tp, args.sp
-    fsdp = args.fsdp or max(1, n // (tp * sp))
-    dp = max(1, n // (fsdp * tp * sp))
-    mesh = build_mesh(MeshSpec(dp=dp, fsdp=fsdp, sp=sp, tp=tp))
+    tp, sp, pp, ep = args.tp, args.sp, args.pp, args.ep
+    fsdp = args.fsdp or max(1, n // (tp * sp * pp * ep))
+    dp = max(1, n // (fsdp * tp * sp * pp * ep))
+    mesh = build_mesh(MeshSpec(dp=dp, fsdp=fsdp, pp=pp, sp=sp, tp=tp, ep=ep))
 
     if args.size == "8b":
         cfg = llama.LlamaConfig.llama3_8b()
@@ -45,8 +49,18 @@ def main(argv: list[str] | None = None) -> dict:
         cfg = llama.LlamaConfig.tiny(vocab_size=512, seq_len=args.seq_len)
     if args.ring_attention:
         cfg = dataclasses.replace(cfg, use_ring_attention=True)
+    if args.experts:
+        cfg = dataclasses.replace(cfg, n_experts=args.experts)
+    if pp > 1:
+        cfg = dataclasses.replace(
+            cfg, pp_stages=pp, pp_microbatches=args.pp_microbatches
+        )
 
-    batch = args.global_batch_size or max(1, dp * fsdp) * 1
+    # Default batch: divisible by the data shards AND the pipeline
+    # microbatch count (pp layouts with dp*fsdp == 1 would otherwise
+    # default to batch 1 and fail microbatch splitting).
+    microbatches = (args.pp_microbatches or pp) if pp > 1 else 1
+    batch = args.global_batch_size or max(1, dp * fsdp) * microbatches
     trainer = llama.make_trainer(
         cfg,
         mesh,
@@ -81,7 +95,7 @@ def main(argv: list[str] | None = None) -> dict:
     return {
         "final_loss": losses[-1],
         "steps": len(losses),
-        "mesh": {"dp": dp, "fsdp": fsdp, "sp": sp, "tp": tp},
+        "mesh": {"dp": dp, "fsdp": fsdp, "pp": pp, "sp": sp, "tp": tp, "ep": ep},
         "params": llama.param_count(cfg),
     }
 
