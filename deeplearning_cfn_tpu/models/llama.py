@@ -336,10 +336,9 @@ def forward_with_aux(
         if cfg.pp_stages > 1:
             # Stage-stacked params but no pp mesh axis (single-device runs):
             # fold [pp, L/pp, ...] back to [L, ...] and scan sequentially.
-            layer_tree = jax.tree_util.tree_map(
-                lambda p: p.reshape(p.shape[0] * p.shape[1], *p.shape[2:]),
-                layer_tree,
-            )
+            from deeplearning_cfn_tpu.parallel.pipeline import unstack_stages
+
+            layer_tree = unstack_stages(layer_tree)
         (x, aux_sum), _ = jax.lax.scan(
             scan_body, (x, jnp.zeros((), jnp.float32)), layer_tree
         )

@@ -1,0 +1,205 @@
+"""Autoregressive decoding for the Llama family — the inference path.
+
+The reference is a training-only stack (no serving/inference anywhere in
+SURVEY.md); generation is part of the TPU framework's completeness story
+for its flagship transformer.  TPU-first design:
+
+- **Static shapes end-to-end**: the KV cache is a fixed [L, B, max_seq,
+  Hkv, D] buffer; every decode step attends over the full buffer with a
+  position mask instead of slicing a growing prefix — no dynamic shapes,
+  one compiled step regardless of position.
+- **Whole generation inside one jit**: prefill writes the prompt's K/V
+  with a single batched forward, then ``lax.scan`` runs the decode steps
+  (sample -> embed -> one-token forward -> cache update) with the cache as
+  carry.  Python never touches the loop.
+- **Scan over layers with cache carry**: the decode-step block reuses the
+  training weights (scan-stacked [L, ...]) and scans the layer axis with
+  the per-layer cache slice, so parameter layout is identical between
+  training and inference — a checkpoint restores straight into serving.
+- Greedy or temperature sampling via ``jax.random.categorical``.
+
+Pipeline checkpoints decode directly (stage-stacked layers fold back to
+the flat scan layout).  MoE configs route per decode call: expert
+capacity is recomputed for each step's tokens, so with a config whose
+prompt overflows expert capacity the cached logits can differ from the
+teacher-forced training forward (which drops overflowed tokens batch-
+wide).  This per-call routing is the standard serving behavior; the
+dense path is bit-matched to training by tests/test_llama_decode.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning_cfn_tpu.models.llama import LlamaConfig
+from deeplearning_cfn_tpu.ops.attention import (
+    dot_product_attention,
+    rms_norm,
+    rotary_embedding,
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class KVCache:
+    """Per-layer K/V buffers, layer axis leading (scan carry)."""
+
+    k: jax.Array  # [L, B, max_seq, Hkv, D]
+    v: jax.Array
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_seq: int) -> KVCache:
+    shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, cfg.dtype),
+        v=jnp.zeros(shape, cfg.dtype),
+    )
+
+
+def _flat_layers(cfg: LlamaConfig, params: dict) -> dict:
+    """Training params may be stage-stacked ([pp, L/pp, ...]); decoding
+    always scans the flat [L, ...] layout."""
+    layers = params["layers"]
+    if cfg.pp_stages > 1:
+        from deeplearning_cfn_tpu.parallel.pipeline import unstack_stages
+
+        layers = unstack_stages(layers)
+    return layers
+
+
+def _attend_cached(
+    q: jax.Array,  # [B, S, H, D]
+    cache_k: jax.Array,  # [B, max_seq, Hkv, D]
+    cache_v: jax.Array,
+    valid_len: jax.Array,  # scalar: positions < valid_len are real
+    causal_offset: jax.Array,  # position of q[0] in the sequence
+) -> jax.Array:
+    """Attention over the full static cache: the training attention op
+    with an explicit validity+causal mask (causality by position, since q
+    and cache indices are offset from each other)."""
+    S = q.shape[1]
+    max_seq = cache_k.shape[1]
+    kpos = jnp.arange(max_seq)
+    qpos = causal_offset + jnp.arange(S)
+    mask = (kpos[None, :] <= qpos[:, None]) & (kpos[None, :] < valid_len)
+    return dot_product_attention(
+        q, cache_k, cache_v, causal=False, mask=mask[None, None]
+    )
+
+
+def _block_cached(cfg, x, lp, lk, lv, positions, valid_len, offset):
+    """One decoder block over cached K/V.  Returns (x, new_lk, new_lv).
+
+    Mirrors llama._block (same weights, same math) with the attention
+    context coming from the cache buffer instead of the current batch.
+    """
+    B, S, d = x.shape
+    hd = cfg.head_dim
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(B, S, cfg.n_heads, hd)
+    k = (h @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
+    v = (h @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+    q = rotary_embedding(q, positions, cfg.rope_theta)
+    k = rotary_embedding(k, positions, cfg.rope_theta)
+    lk = jax.lax.dynamic_update_slice(lk, k.astype(lk.dtype), (0, offset, 0, 0))
+    lv = jax.lax.dynamic_update_slice(lv, v.astype(lv.dtype), (0, offset, 0, 0))
+    attn = _attend_cached(q, lk, lv, valid_len, offset)
+    x = x + attn.reshape(B, S, cfg.n_heads * hd) @ lp["wo"]
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    if cfg.moe is not None:
+        from deeplearning_cfn_tpu.ops.moe import moe_mlp
+
+        y, _aux = moe_mlp(cfg.moe, lp["moe"], h)
+        return x + y, lk, lv
+    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    return x, lk, lv
+
+
+def _forward_cached(
+    cfg: LlamaConfig,
+    params: dict,
+    tokens: jax.Array,  # [B, S]
+    cache: KVCache,
+    offset: jax.Array,  # scalar: position of tokens[:, 0]
+) -> tuple[jax.Array, KVCache]:
+    """Forward over S tokens starting at ``offset``, reading and writing
+    the cache.  Returns (logits [B, S, V], updated cache)."""
+    B, S = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    positions = offset + jnp.arange(S, dtype=jnp.int32)
+    valid_len = offset + S
+    layers = _flat_layers(cfg, params)
+
+    def scan_body(x, layer):
+        lp, lk, lv = layer
+        x, lk, lv = _block_cached(cfg, x, lp, lk, lv, positions, valid_len, offset)
+        return x, (lk, lv)
+
+    x, (new_k, new_v) = jax.lax.scan(scan_body, x, (layers, cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if cfg.tied_embeddings:
+        logits = x @ params["embed"].astype(cfg.dtype).T
+    else:
+        logits = x @ params["output"]
+    return logits.astype(jnp.float32), KVCache(k=new_k, v=new_v)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "temperature"),
+)
+def generate(
+    cfg: LlamaConfig,
+    params: dict,
+    prompt: jax.Array,  # [B, S_prompt] int32
+    rng: jax.Array,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+) -> jax.Array:
+    """Prefill + scan-decode.  Returns [B, max_new_tokens] sampled tokens.
+
+    temperature 0.0 = greedy argmax; > 0 samples from
+    ``softmax(logits / temperature)``.
+    """
+    B, S = prompt.shape
+    max_seq = S + max_new_tokens
+    if max_seq > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {S} + {max_new_tokens} new tokens exceeds "
+            f"max_seq_len={cfg.max_seq_len}"
+        )
+    cache = init_cache(cfg, B, max_seq)
+    logits, cache = _forward_cached(
+        cfg, params, prompt, cache, jnp.asarray(0, jnp.int32)
+    )
+
+    def sample(logits_1, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits_1, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits_1 / temperature).astype(jnp.int32)
+
+    keys = jax.random.split(rng, max_new_tokens)
+    first = sample(logits[:, -1], keys[0])
+
+    def step(carry, key):
+        token, cache, pos = carry
+        logits, cache = _forward_cached(
+            cfg, params, token[:, None], cache, pos
+        )
+        nxt = sample(logits[:, -1], key)
+        return (nxt, cache, pos + 1), token
+
+    # max_new_tokens - 1 decode steps: the scan emits its carried token,
+    # so the final sampled token comes out as the end carry (no wasted
+    # trailing forward).
+    (last, _, _), tokens = jax.lax.scan(
+        step, (first, cache, jnp.asarray(S, jnp.int32)), keys[1:]
+    )
+    return jnp.concatenate(
+        [jnp.swapaxes(tokens, 0, 1), last[:, None]], axis=1
+    )  # [B, max_new_tokens]

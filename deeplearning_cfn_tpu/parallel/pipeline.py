@@ -68,6 +68,14 @@ def stack_stages(layer_tree: Any, n_stages: int) -> Any:
     return jax.tree_util.tree_map(reshape, layer_tree)
 
 
+def unstack_stages(layer_tree: Any) -> Any:
+    """Inverse of :func:`stack_stages`: ``[pp, L/pp, ...]`` -> ``[L, ...]``
+    in scan order (single-device fallback and decoding use the flat layout)."""
+    return jax.tree_util.tree_map(
+        lambda p: p.reshape(p.shape[0] * p.shape[1], *p.shape[2:]), layer_tree
+    )
+
+
 def stage_specs(layer_specs: Any) -> Any:
     """Prepend the ``pp`` axis to each per-layer PartitionSpec."""
     return jax.tree_util.tree_map(
