@@ -149,6 +149,44 @@ def cmd_delete(args) -> int:
     return 0
 
 
+def cmd_recover(args) -> int:
+    """Automates the reference's manual recovery runbook (delete stack,
+    recreate reusing the retained file system, resume from checkpoint —
+    examples/distributed-tensorflow/README.md:85-87)."""
+    from deeplearning_cfn_tpu.provision.provisioner import ProvisionFailure, Provisioner
+
+    spec = _load_spec(args)
+    backend = _backend_for(spec)
+    prov = Provisioner(backend, spec)
+    t0 = time.monotonic()
+    print(f"recovering cluster {spec.name!r}...", file=sys.stderr)
+    try:
+        result = prov.recover()
+    except ProvisionFailure as e:
+        print(f"RECOVER FAILED after {time.monotonic() - t0:.0f}s: {e}", file=sys.stderr)
+        return 1
+    print(
+        json.dumps(
+            {
+                "cluster": spec.name,
+                "elapsed_s": round(time.monotonic() - t0, 1),
+                "workers": result.realized_workers,
+                "storage": result.storage.storage_id,
+                "storage_reused": not result.storage.created,
+                "degraded": result.degraded,
+                "resume_hint": (
+                    "checkpoints on the reused storage restore automatically "
+                    "via Checkpointer.restore_latest"
+                    if not result.storage.created
+                    else "no retained storage found; training restarts fresh"
+                ),
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
 def cmd_plan(args) -> int:
     spec = _load_spec(args)
     # Render against a hypothetical full-size contract (no cloud calls).
@@ -317,6 +355,7 @@ def main(argv: list[str] | None = None) -> int:
         ("create", cmd_create),
         ("describe", cmd_describe),
         ("delete", cmd_delete),
+        ("recover", cmd_recover),
         ("plan", cmd_plan),
         ("run", cmd_run),
         ("startup-script", cmd_startup_script),

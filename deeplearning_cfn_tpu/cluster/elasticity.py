@@ -58,6 +58,9 @@ class ElasticityController:
     def attach(self) -> None:
         self.backend.events.subscribe(self.handle)
 
+    def detach(self) -> None:
+        self.backend.events.unsubscribe(self.handle)
+
     # --- event dispatch (lambda_handler + get_handler analog) -----------
     def handle(self, event: LifecycleEvent) -> None:
         policy = self.policies.get(event.group)

@@ -38,6 +38,8 @@ def main(argv: list[str] | None = None) -> dict:
     p.add_argument("--target_accuracy", type=float, default=None,
                    help="stop early when train accuracy reaches this "
                         "(time-to-accuracy mode, README.md:141)")
+    p.add_argument("--eval_steps", type=int, default=0,
+                   help="held-out eval batches after training (0 = skip)")
     args = p.parse_args(argv)
     maybe_init_distributed()
     batch = args.global_batch_size or 64 * len(jax.devices())
@@ -92,12 +94,23 @@ def main(argv: list[str] | None = None) -> dict:
     if ckpt:
         ckpt.save(int(jax.device_get(state.step)), state)
         ckpt.close()
-    return {
+    result = {
         "final_loss": losses[-1],
         "final_accuracy": last_accuracy["value"],
         "steps": len(losses),
         "history": logger.history,
     }
+    if args.eval_steps:
+        # Held-out split: same task (template_seed matches the training
+        # set's templates), disjoint sample stream.
+        eval_ds = SyntheticDataset(
+            shape=(32, 32, 3), num_classes=10, batch_size=batch,
+            seed=10_000, template_seed=0,
+        )
+        result["eval"] = trainer.evaluate(
+            state, eval_ds.batches(args.eval_steps), steps=args.eval_steps
+        )
+    return result
 
 
 if __name__ == "__main__":

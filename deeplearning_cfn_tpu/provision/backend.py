@@ -122,6 +122,11 @@ class Backend:
         """Returns True if deleted; False if retained by policy."""
         raise NotImplementedError
 
+    def storage_exists(self, storage_id: str) -> bool:
+        """Whether retained storage is still present (recover() checks
+        before reusing)."""
+        raise NotImplementedError
+
     # --- stack signaling (WaitCondition / signal_resource analog) ------
     def signal_resource(self, resource: str, signal: ResourceSignal) -> None:
         raise NotImplementedError

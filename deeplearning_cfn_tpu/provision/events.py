@@ -52,6 +52,14 @@ class EventBus:
     def subscribe(self, handler: EventHandler) -> None:
         self._subscribers.append(handler)
 
+    def unsubscribe(self, handler: EventHandler) -> None:
+        """Remove a handler (no-op if absent) — a retired controller must
+        not keep answering lifecycle events for a recreated cluster."""
+        try:
+            self._subscribers.remove(handler)
+        except ValueError:
+            pass
+
     def publish(self, event: LifecycleEvent) -> None:
         for handler in list(self._subscribers):
             handler(event)

@@ -337,6 +337,13 @@ class GCPBackend(Backend):
         self.transport("DELETE", f"b/{storage_id}", None)
         return True
 
+    def storage_exists(self, storage_id: str) -> bool:
+        try:
+            self.transport("GET", f"b/{storage_id}", None)
+            return True
+        except Exception:
+            return False
+
     # -- signaling: GCS marker objects --------------------------------------
     def signal_resource(self, resource: str, signal: ResourceSignal) -> None:
         self._signals[resource] = signal

@@ -84,6 +84,7 @@ class Provisioner:
         self.spec = spec.validate()
         self.contract_root = contract_root
         self._storage: StorageHandle | None = None
+        self._controller = None
 
     # -- resource names ---------------------------------------------------
     @property
@@ -119,6 +120,7 @@ class Provisioner:
             )
         )
         controller.attach()
+        self._controller = controller
 
         self._storage = self.backend.create_or_reuse_storage(
             kind=spec.storage.kind,
@@ -256,6 +258,11 @@ class Provisioner:
         }
 
     def delete(self, force_storage: bool = False) -> dict[str, object]:
+        if self._controller is not None:
+            # A retired controller must not answer lifecycle events for a
+            # later cluster with the same name (recover()).
+            self._controller.detach()
+            self._controller = None
         self.backend.delete_group(self.group_name)
         storage_deleted = False
         if self._storage is not None:
@@ -269,3 +276,36 @@ class Provisioner:
                     self._storage.storage_id,
                 )
         return {"storage_deleted": storage_deleted}
+
+    # -- recover ----------------------------------------------------------
+    def recover(self) -> "ProvisionResult":
+        """Delete the cluster, recreate it reusing the retained storage,
+        and return the fresh provision result — ready to resume from the
+        checkpoints that survived on storage.
+
+        Automates the reference's documented (manual) recovery story:
+        "delete the stack, recreate it reusing the EFS file system,
+        restart training from the last checkpoint"
+        (examples/distributed-tensorflow/README.md:85-87; retention via
+        DeletionPolicy: Retain, deeplearning.template:456).
+        """
+        import dataclasses as _dc
+
+        retained = (
+            self._storage.storage_id
+            if self._storage is not None
+            else self.spec.storage.existing_id
+        )
+        self.delete(force_storage=False)
+        if retained is not None and self.backend.storage_exists(retained):
+            self.spec = _dc.replace(
+                self.spec,
+                storage=_dc.replace(self.spec.storage, existing_id=retained),
+            )
+            log.info("recovering cluster %s reusing storage %s", self.spec.name, retained)
+        else:
+            log.warning(
+                "recover: no retained storage to reuse (fresh storage will "
+                "be created; checkpoints from the previous cluster are gone)"
+            )
+        return self.provision()

@@ -56,15 +56,25 @@ class SyntheticDataset:
     dtype: str = "float32"
 
     noise_scale: float = 1.0
+    # The class templates define the TASK; the seed drives the sample
+    # stream.  A held-out split shares template_seed with the training set
+    # but uses a different seed — same task, disjoint samples.  None =
+    # templates follow ``seed`` (original behavior).
+    template_seed: int | None = None
 
     def batches(self, steps: int) -> Iterator[Batch]:
         rng = np.random.default_rng(self.seed)
         # Each class has a fixed random template; samples are template +
         # noise.  Learnable in a few dozen steps, so "loss decreases" is a
         # meaningful assertion, while noise keeps it from being trivial.
-        templates = rng.standard_normal((self.num_classes, *self.shape)).astype(
-            np.float32
+        template_rng = (
+            np.random.default_rng(self.template_seed)
+            if self.template_seed is not None
+            else rng
         )
+        templates = template_rng.standard_normal(
+            (self.num_classes, *self.shape)
+        ).astype(np.float32)
         for _ in range(steps):
             y = rng.integers(0, self.num_classes, size=self.batch_size).astype(np.int32)
             noise = rng.standard_normal((self.batch_size, *self.shape)).astype(

@@ -28,6 +28,7 @@ Python in the hot loop beyond feeding batches.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -43,6 +44,7 @@ from deeplearning_cfn_tpu.parallel.sharding import (
     infer_param_sharding,
     replicated,
 )
+from deeplearning_cfn_tpu.train.data import device_put_batch
 from deeplearning_cfn_tpu.train.metrics import ThroughputLogger
 from deeplearning_cfn_tpu.utils.logging import get_logger
 
@@ -131,6 +133,7 @@ class Trainer:
         param_shardings: Any = None,
         batch_spec: P | None = None,
         stateful_loss_fn: Callable[..., tuple[jax.Array, tuple[dict, Any]]] | None = None,
+        eval_loss_fn: Callable[..., tuple[jax.Array, dict]] | None = None,
     ):
         self.model = model
         self.mesh = mesh
@@ -138,6 +141,10 @@ class Trainer:
         self.tx = _make_optimizer(config)
         self._custom_loss = loss_fn
         self._custom_stateful_loss = stateful_loss_fn
+        # eval_loss_fn(params, model_state, x, y) -> (loss, metrics): the
+        # eval-mode counterpart of a custom stateful loss (train=False,
+        # no mutation).
+        self._custom_eval_loss = eval_loss_fn
         self._explicit_param_shardings = param_shardings
         # Images: [B, ...] split over the data axes.  Token models pass
         # P(("dp","fsdp"), "sp") to also shard the sequence axis.
@@ -148,19 +155,18 @@ class Trainer:
         self.state_shardings: TrainState | None = None
 
     # --- loss -----------------------------------------------------------
-    def _loss(
-        self, params: Any, model_state: Any, x: jax.Array, y: jax.Array
-    ) -> tuple[jax.Array, tuple[dict, Any]]:
-        if self._custom_stateful_loss is not None:
-            return self._custom_stateful_loss(params, model_state, x, y)
-        if self._custom_loss is not None:
-            loss, aux = self._custom_loss(params, x, y)
-            return loss, (aux, model_state)
+    def _default_objective(
+        self, params: Any, model_state: Any, x: jax.Array, y: jax.Array, train: bool
+    ) -> tuple[jax.Array, dict, Any]:
+        """The default classification objective, shared by the train and
+        eval steps so their metrics stay numerically comparable.  Eval
+        (train=False) disables dropout, reads BatchNorm running stats, and
+        never mutates collections."""
         if self.config.bf16_compute:
             x = x.astype(jnp.bfloat16)
         variables = {"params": params, **model_state}
-        kwargs = {"train": True} if self.config.has_train_arg else {}
-        mutable = [k for k in model_state.keys()]
+        kwargs = {"train": train} if self.config.has_train_arg else {}
+        mutable = list(model_state.keys()) if train else []
         if mutable:
             logits, new_model_state = self.model.apply(
                 variables, x, mutable=mutable, **kwargs
@@ -170,7 +176,20 @@ class Trainer:
             new_model_state = model_state
         loss = softmax_xent(logits, y, self.config.label_smoothing)
         acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
-        return loss, ({"accuracy": acc}, new_model_state)
+        return loss, {"accuracy": acc}, new_model_state
+
+    def _loss(
+        self, params: Any, model_state: Any, x: jax.Array, y: jax.Array
+    ) -> tuple[jax.Array, tuple[dict, Any]]:
+        if self._custom_stateful_loss is not None:
+            return self._custom_stateful_loss(params, model_state, x, y)
+        if self._custom_loss is not None:
+            loss, aux = self._custom_loss(params, x, y)
+            return loss, (aux, model_state)
+        loss, aux, new_model_state = self._default_objective(
+            params, model_state, x, y, train=True
+        )
+        return loss, (aux, new_model_state)
 
     # --- init -----------------------------------------------------------
     def init(self, rng: jax.Array, sample_x: jax.Array) -> TrainState:
@@ -280,6 +299,81 @@ class Trainer:
         # code (e.g. llama._maybe_shard) resolvable during tracing.
         with jax.set_mesh(self.mesh):
             return self.step_fn(state, x, y)
+
+    # --- evaluation -------------------------------------------------------
+    def _build_eval_step(self):
+        def eval_loss(params, model_state, x, y):
+            if self._custom_eval_loss is not None:
+                return self._custom_eval_loss(params, model_state, x, y)
+            if self._custom_stateful_loss is not None:
+                # No eval variant supplied: the custom loss applies the
+                # model however it was written (usually train mode), so
+                # these metrics carry train-mode semantics.
+                log.warning(
+                    "evaluate() with a stateful loss and no eval_loss_fn "
+                    "runs the model in train mode; pass eval_loss_fn for "
+                    "true eval semantics"
+                )
+                loss, (aux, _) = self._custom_stateful_loss(params, model_state, x, y)
+                return loss, aux
+            if self._custom_loss is not None:
+                return self._custom_loss(params, x, y)
+            loss, aux, _ = self._default_objective(
+                params, model_state, x, y, train=False
+            )
+            return loss, aux
+
+        precision = self.config.matmul_precision
+
+        def eval_fn(state: TrainState, x: jax.Array, y: jax.Array):
+            # Same matmul precision as the train step: eval metrics must be
+            # comparable to the train metrics they sit next to.
+            ctx = (
+                jax.default_matmul_precision(precision)
+                if precision
+                else contextlib.nullcontext()
+            )
+            with ctx:
+                loss, aux = eval_loss(state.params, state.model_state, x, y)
+            return {"loss": loss, **aux}
+
+        assert self.state_shardings is not None, "call init() before evaluate"
+        return jax.jit(
+            eval_fn,
+            in_shardings=(self.state_shardings, self.batch_sharding, self.batch_sharding),
+        )
+
+    @property
+    def eval_step(self):
+        if getattr(self, "_eval_fn", None) is None:
+            self._eval_fn = self._build_eval_step()
+        return self._eval_fn
+
+    def evaluate(self, state: TrainState, batches, steps: int | None = None) -> dict:
+        """Run the no-gradient eval step over a batch iterator and return
+        example-weighted mean metrics (plus ``examples`` seen).  The held-
+        out counterpart of the reference's train-accuracy walkthrough
+        metric (README.md:141)."""
+        totals: dict[str, float] = {}
+        examples = 0
+        eval_fn = self.eval_step
+        # islice, not enumerate+break: break would pull (and discard) one
+        # batch past the limit from the caller's iterator.
+        if steps is not None:
+            batches = itertools.islice(batches, steps)
+        for batch in batches:
+            x, y = device_put_batch(batch, self.batch_sharding)
+            with jax.set_mesh(self.mesh):
+                metrics = eval_fn(state, x, y)
+            n = len(batch.x)
+            examples += n
+            for k, v in metrics.items():
+                totals[k] = totals.get(k, 0.0) + float(v) * n
+        if examples == 0:
+            return {"examples": 0}
+        out = {k: v / examples for k, v in totals.items()}
+        out["examples"] = examples
+        return out
 
     # --- convenience loop (the MonitoredTrainingSession analog) ----------
     def fit(

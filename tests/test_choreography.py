@@ -225,3 +225,44 @@ def test_env_sh_paths_point_at_published_root(contract_root):
     env = (explicit_root / "env.sh").read_text()
     assert f"DEEPLEARNING_WORKERS_PATH={explicit_root}/workers" in env
     assert (explicit_root / "workers").exists()
+
+
+def test_recover_reuses_retained_storage(contract_root):
+    """The recreate-and-resume story, automated: delete retains storage,
+    recover reuses it (same id), and the fresh cluster is ready."""
+    backend = LocalBackend(clock=FakeClock())
+    prov = Provisioner(backend, make_spec(workers=4), contract_root=contract_root)
+    first = prov.provision()
+    storage_id = first.storage.storage_id
+    assert first.storage.created
+
+    recovered = prov.recover()
+    assert recovered.storage.storage_id == storage_id
+    assert not recovered.storage.created  # reused, not recreated
+    assert recovered.realized_workers == 4
+    assert not recovered.degraded
+    assert prov.describe()["ready"] is True
+
+
+def test_recover_detaches_old_controller(contract_root):
+    """The retired controller must stop answering lifecycle events —
+    otherwise every recover leaks a subscriber that double-posts
+    group-setup messages."""
+    backend = LocalBackend(clock=FakeClock())
+    prov = Provisioner(backend, make_spec(workers=2), contract_root=contract_root)
+    prov.provision()
+    assert len(backend.events._subscribers) == 1
+    prov.recover()
+    assert len(backend.events._subscribers) == 1  # old one detached
+    prov.recover()
+    assert len(backend.events._subscribers) == 1
+
+
+def test_recover_without_prior_cluster_creates_fresh(contract_root):
+    """recover on a backend with no such cluster degrades to a plain
+    create (fresh storage) instead of failing."""
+    backend = LocalBackend(clock=FakeClock())
+    prov = Provisioner(backend, make_spec(workers=2), contract_root=contract_root)
+    result = prov.recover()
+    assert result.storage.created
+    assert result.realized_workers == 2

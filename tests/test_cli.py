@@ -75,3 +75,12 @@ def test_delete(template_file, capsys, contract_root):
     assert main(["delete", template_file]) == 0
     out = json.loads(capsys.readouterr().out)
     assert out["storage_deleted"] is False
+
+
+def test_recover(template_file, capsys, contract_root):
+    """dlcfn recover provisions a fresh cluster (no prior one in this
+    process) and reports the resume hint."""
+    assert main(["recover", template_file]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["workers"] >= 1
+    assert "resume_hint" in out
