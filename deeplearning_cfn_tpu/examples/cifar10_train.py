@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from deeplearning_cfn_tpu.examples.common import (
     base_parser,
     default_mesh,
+    image_batches,
     maybe_init_distributed,
 )
 from deeplearning_cfn_tpu.models.vgg import CONFIGS, VGG
@@ -64,7 +65,8 @@ def main(argv: list[str] | None = None) -> dict:
     ds = SyntheticDataset(
         shape=(32, 32, 3), num_classes=10, batch_size=batch, noise_scale=1.0
     )
-    sample = next(iter(ds.batches(1)))
+    batches = image_batches(args, (32, 32, 3), ds)
+    sample = next(iter(batches(1)))
     state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
     ckpt = None
     if args.checkpoint_dir:
@@ -88,7 +90,7 @@ def main(argv: list[str] | None = None) -> dict:
         )
 
     state, losses = trainer.fit(
-        state, ds.batches(args.steps), steps=args.steps, logger=logger,
+        state, batches(args.steps), steps=args.steps, logger=logger,
         stop_fn=stop_fn, checkpointer=ckpt,
     )
     if ckpt:
@@ -101,14 +103,20 @@ def main(argv: list[str] | None = None) -> dict:
         "history": logger.history,
     }
     if args.eval_steps:
-        # Held-out split: same task (template_seed matches the training
-        # set's templates), disjoint sample stream.
-        eval_ds = SyntheticDataset(
-            shape=(32, 32, 3), num_classes=10, batch_size=batch,
-            seed=10_000, template_seed=0,
-        )
+        if args.data_dir:
+            # Real records: score an unshuffled pass over the same data
+            # source (the eval split is whatever the operator staged).
+            eval_batches = image_batches(args, (32, 32, 3), ds, eval_mode=True)
+        else:
+            # Synthetic: same task (template_seed matches the training
+            # templates), disjoint sample stream.
+            eval_ds = SyntheticDataset(
+                shape=(32, 32, 3), num_classes=10, batch_size=batch,
+                seed=10_000, template_seed=0,
+            )
+            eval_batches = eval_ds.batches
         result["eval"] = trainer.evaluate(
-            state, eval_ds.batches(args.eval_steps), steps=args.eval_steps
+            state, eval_batches(args.eval_steps), steps=args.eval_steps
         )
     return result
 

@@ -44,4 +44,61 @@ def base_parser(description: str) -> argparse.ArgumentParser:
     p.add_argument("--log_every", type=int, default=10)
     p.add_argument("--strategy", choices=["dp", "fsdp"], default="dp")
     p.add_argument("--checkpoint_dir", default=os.environ.get("DLCFN_CHECKPOINT_DIR"))
+    p.add_argument(
+        "--data_dir",
+        default=os.environ.get("DLCFN_DATA_DIR"),
+        help="colon-separated candidate dirs of DLC1 record files (probed "
+             "in order, like the reference's FSx->EFS->EBS probe); unset = "
+             "synthetic data",
+    )
     return p
+
+
+def image_batches(args, image_shape, fallback_ds, eval_mode: bool = False):
+    """Batches for an image trainer: DLC1 records through the native
+    loader when ``--data_dir`` is set (first existing candidate dir wins,
+    the run.sh:21-35 data-source probe), else the synthetic dataset.
+
+    Every process feeds the trainer the full global batch (the fit()
+    contract), so in multi-process runs the record stream must be
+    IDENTICAL on every host: one reader thread (deterministic batch
+    order) and the shared default seed.  Per-host shard loading belongs
+    to the `make_array_from_process_local_data` path
+    (examples/multiprocess_smoke.py), not here.
+
+    ``eval_mode`` gives an unshuffled single pass for held-out scoring.
+    Returns ``fn(steps) -> iterator[Batch]``.
+    """
+    if not args.data_dir:
+        return fallback_ds.batches
+    from pathlib import Path
+
+    from deeplearning_cfn_tpu.train.data import probe_data_source
+    from deeplearning_cfn_tpu.train.native_loader import NativeRecordLoader
+    from deeplearning_cfn_tpu.train.records import RecordSpec
+
+    root = probe_data_source(args.data_dir.split(":"))
+    if root is None:
+        raise SystemExit(f"--data_dir: none of {args.data_dir!r} exists")
+    paths = sorted(Path(root).glob("*.dlc"))
+    if not paths:
+        raise SystemExit(f"--data_dir: no .dlc record files under {root}")
+    batch = args.global_batch_size or fallback_ds.batch_size
+    spec = RecordSpec.classification(image_shape)
+    multi = jax.process_count() > 1
+    loader = NativeRecordLoader(
+        paths,
+        spec,
+        batch_size=batch,
+        shuffle=not eval_mode,
+        loop=not eval_mode,
+        # >1 reader threads deliver batches out of order; fine on one
+        # host, divergent across hosts.
+        n_threads=1 if (multi or eval_mode) else 4,
+    )
+    log.info(
+        "data%s: %d record files under %s (%d records, %d batches/epoch)",
+        " [eval]" if eval_mode else "", len(paths), root,
+        loader.shard_records, loader.batches_per_epoch,
+    )
+    return loader.batches

@@ -15,7 +15,12 @@ import argparse
 import jax
 import jax.numpy as jnp
 
-from deeplearning_cfn_tpu.examples.common import base_parser, default_mesh, maybe_init_distributed
+from deeplearning_cfn_tpu.examples.common import (
+    base_parser,
+    default_mesh,
+    image_batches,
+    maybe_init_distributed,
+)
 from deeplearning_cfn_tpu.models.resnet import ResNet50, ResNet101, ResNet152
 from deeplearning_cfn_tpu.train.data import SyntheticDataset
 from deeplearning_cfn_tpu.train.metrics import ThroughputLogger
@@ -46,12 +51,13 @@ def main(argv: list[str] | None = None) -> dict:
         ),
     )
     ds = SyntheticDataset.imagenet_like(batch_size=batch, image_size=args.image_size)
-    sample = next(iter(ds.batches(1)))
+    batches = image_batches(args, (args.image_size, args.image_size, 3), ds)
+    sample = next(iter(batches(1)))
     state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
     logger = ThroughputLogger(
         global_batch_size=batch, log_every=args.log_every, name=f"resnet{args.depth}"
     )
-    state, losses = trainer.fit(state, ds.batches(args.steps), steps=args.steps, logger=logger)
+    state, losses = trainer.fit(state, batches(args.steps), steps=args.steps, logger=logger)
     return {"final_loss": losses[-1], "steps": len(losses), "history": logger.history}
 
 

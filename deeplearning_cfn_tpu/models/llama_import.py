@@ -61,6 +61,12 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16) -> LlamaConfig:
             "model has bias-free projections; importing would silently "
             "drop the bias terms)"
         )
+    act = getattr(hf_config, "hidden_act", "silu")
+    if act not in ("silu", "swish"):
+        raise ImportError_(
+            f"hidden_act={act!r} unsupported (this model's MLP is SwiGLU/"
+            "silu; importing would apply the wrong activation)"
+        )
     return LlamaConfig(
         vocab_size=hf_config.vocab_size,
         dim=hf_config.hidden_size,

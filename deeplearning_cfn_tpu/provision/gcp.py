@@ -338,10 +338,14 @@ class GCPBackend(Backend):
         return True
 
     def storage_exists(self, storage_id: str) -> bool:
+        # Only a not-found (KeyError, the transport convention shared with
+        # LocalBackend) means "gone"; transient API errors must propagate —
+        # treating a 503 as "deleted" would make recover() abandon live
+        # checkpoints.
         try:
             self.transport("GET", f"b/{storage_id}", None)
             return True
-        except Exception:
+        except KeyError:
             return False
 
     # -- signaling: GCS marker objects --------------------------------------

@@ -21,6 +21,7 @@ rollback analog).
 
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass
 from pathlib import Path
 
@@ -145,6 +146,7 @@ class Provisioner:
         )
 
         contract = self._run_bootstrap(coord_q, worker_q)
+        self._record_storage()
         result = ProvisionResult(
             spec=spec,
             contract=contract,
@@ -277,6 +279,45 @@ class Provisioner:
                 )
         return {"storage_deleted": storage_deleted}
 
+    # -- storage record (durable; what recover() reads cross-process) -----
+    def _storage_record_path(self) -> Path:
+        root = self.contract_root or ClusterContract.root_dir()
+        return Path(root) / "storage.json"
+
+    def _record_storage(self) -> None:
+        """Persist the storage binding next to the cluster contract so a
+        LATER process (the disaster-recovery scenario: the provisioning
+        process is gone) can find the retained storage to reuse."""
+        if self._storage is None:
+            return
+        path = self._storage_record_path()
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(
+            json.dumps(
+                {
+                    "cluster": self.spec.name,
+                    "storage_id": self._storage.storage_id,
+                    "kind": self._storage.kind,
+                    "mount_point": self._storage.mount_point,
+                    "retain_on_delete": self._storage.retain_on_delete,
+                }
+            )
+        )
+
+    def _read_storage_record(self) -> str | None:
+        path = self._storage_record_path()
+        try:
+            record = json.loads(path.read_text())
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+        if record.get("cluster") != self.spec.name:
+            log.warning(
+                "storage record at %s is for cluster %r, not %r; ignoring",
+                path, record.get("cluster"), self.spec.name,
+            )
+            return None
+        return record.get("storage_id")
+
     # -- recover ----------------------------------------------------------
     def recover(self) -> "ProvisionResult":
         """Delete the cluster, recreate it reusing the retained storage,
@@ -291,10 +332,13 @@ class Provisioner:
         """
         import dataclasses as _dc
 
+        # Priority: live handle (same-process) > durable record written at
+        # provision time (cross-process, the real disaster scenario) >
+        # spec-pinned existing_id.
         retained = (
             self._storage.storage_id
             if self._storage is not None
-            else self.spec.storage.existing_id
+            else (self._read_storage_record() or self.spec.storage.existing_id)
         )
         self.delete(force_storage=False)
         if retained is not None and self.backend.storage_exists(retained):

@@ -266,3 +266,18 @@ def test_recover_without_prior_cluster_creates_fresh(contract_root):
     result = prov.recover()
     assert result.storage.created
     assert result.realized_workers == 2
+
+
+def test_recover_from_fresh_process_reads_storage_record(contract_root):
+    """The real disaster scenario: the provisioning process is gone.  A
+    NEW Provisioner (fresh process analog) must find the retained storage
+    via the durable record next to the contract."""
+    backend = LocalBackend(clock=FakeClock())
+    first = Provisioner(backend, make_spec(workers=2), contract_root=contract_root).provision()
+    storage_id = first.storage.storage_id
+    assert (contract_root / "storage.json").exists()
+
+    fresh = Provisioner(backend, make_spec(workers=2), contract_root=contract_root)
+    recovered = fresh.recover()
+    assert recovered.storage.storage_id == storage_id
+    assert not recovered.storage.created

@@ -114,3 +114,14 @@ def test_rope_scaling_rejected():
     )
     with pytest.raises(ImportError_, match="rope_scaling"):
         config_from_hf(hf_cfg)
+
+
+def test_bias_and_activation_guards():
+    base = dict(
+        vocab_size=96, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+    )
+    with pytest.raises(ImportError_, match="bias"):
+        config_from_hf(transformers.LlamaConfig(**base, attention_bias=True))
+    with pytest.raises(ImportError_, match="hidden_act"):
+        config_from_hf(transformers.LlamaConfig(**base, hidden_act="gelu"))

@@ -185,3 +185,42 @@ def test_closed_loader_raises_not_segfaults(tmp_path):
         _ = loader.batches_per_epoch
     with pytest.raises(LoaderError, match="closed"):
         loader.next_raw()
+
+
+def test_image_batches_probes_and_loads(tmp_path):
+    """examples/common.image_batches: --data_dir probes candidates in
+    order (the run.sh FSx->EFS->EBS probe) and feeds DLC1 records through
+    the native loader; unset falls back to the synthetic dataset."""
+    import argparse
+
+    from deeplearning_cfn_tpu.examples.common import image_batches
+    from deeplearning_cfn_tpu.train.records import RecordSpec, write_dataset
+
+    ds = SyntheticDataset(shape=(8, 8, 1), num_classes=4, batch_size=8)
+    spec = RecordSpec.classification((8, 8, 1))
+    data_root = tmp_path / "present"
+    data_root.mkdir()
+    write_dataset(data_root / "a.dlc", spec, ds.batches(4), steps=4)
+
+    args = argparse.Namespace(
+        data_dir=f"{tmp_path}/missing:{data_root}", global_batch_size=8
+    )
+    batches = image_batches(args, (8, 8, 1), ds)
+    got = list(batches(3))
+    assert len(got) == 3 and got[0].x.shape == (8, 8, 8, 1)
+
+    # fallback: no data_dir -> synthetic
+    args2 = argparse.Namespace(data_dir=None, global_batch_size=8)
+    assert image_batches(args2, (8, 8, 1), ds) == ds.batches
+
+    # error: candidates all missing
+    args3 = argparse.Namespace(data_dir=f"{tmp_path}/nope", global_batch_size=8)
+    with pytest.raises(SystemExit, match="none of"):
+        image_batches(args3, (8, 8, 1), ds)
+
+    # error: dir exists but holds no records
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    args4 = argparse.Namespace(data_dir=str(empty), global_batch_size=8)
+    with pytest.raises(SystemExit, match="no .dlc"):
+        image_batches(args4, (8, 8, 1), ds)
