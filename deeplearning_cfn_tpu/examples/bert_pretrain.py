@@ -13,6 +13,7 @@ from deeplearning_cfn_tpu.examples.common import base_parser, default_mesh, mayb
 from deeplearning_cfn_tpu.models import bert
 from deeplearning_cfn_tpu.train.checkpoint import Checkpointer
 from deeplearning_cfn_tpu.train.data import SyntheticMLMDataset
+from deeplearning_cfn_tpu.examples.common import metrics_sink
 from deeplearning_cfn_tpu.train.metrics import ThroughputLogger
 from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
 
@@ -50,7 +51,8 @@ def main(argv: list[str] | None = None) -> dict:
         restored = ckpt.restore_latest(state)
         if restored is not None:
             state, start = restored
-    logger = ThroughputLogger(global_batch_size=batch, log_every=args.log_every, name="bert")
+    _sink = metrics_sink(args, 'bert')
+    logger = ThroughputLogger(global_batch_size=batch, log_every=args.log_every, name="bert", sink=_sink)
     state, losses = trainer.fit(
         state, ds.batches(args.steps), steps=args.steps, logger=logger, checkpointer=ckpt
     )

@@ -25,6 +25,7 @@ from deeplearning_cfn_tpu.examples.common import (
     default_mesh,
     image_batches,
     maybe_init_distributed,
+    metrics_sink,
 )
 from deeplearning_cfn_tpu.models.vgg import CONFIGS, VGG
 from deeplearning_cfn_tpu.train.data import SyntheticDataset
@@ -41,6 +42,10 @@ def main(argv: list[str] | None = None) -> dict:
                         "(time-to-accuracy mode, README.md:141)")
     p.add_argument("--eval_steps", type=int, default=0,
                    help="held-out eval batches after training (0 = skip)")
+    p.add_argument("--eval_data_dir", default=None,
+                   help="record dir(s) for a genuinely held-out eval split; "
+                        "unset with --data_dir = an unshuffled pass over the "
+                        "TRAINING records (reported with split='train')")
     args = p.parse_args(argv)
     maybe_init_distributed()
     batch = args.global_batch_size or 64 * len(jax.devices())
@@ -76,8 +81,10 @@ def main(argv: list[str] | None = None) -> dict:
         restored = ckpt.restore_latest(state)
         if restored is not None:
             state, _ = restored
+    sink = metrics_sink(args, args.model)
     logger = ThroughputLogger(
-        global_batch_size=batch, log_every=args.log_every, name=args.model
+        global_batch_size=batch, log_every=args.log_every, name=args.model,
+        sink=sink,
     )
 
     last_accuracy = {"value": 0.0}
@@ -103,10 +110,20 @@ def main(argv: list[str] | None = None) -> dict:
         "history": logger.history,
     }
     if args.eval_steps:
-        if args.data_dir:
-            # Real records: score an unshuffled pass over the same data
-            # source (the eval split is whatever the operator staged).
+        import copy
+
+        if args.eval_data_dir:
+            # Operator-staged held-out records.
+            eval_args = copy.copy(args)
+            eval_args.data_dir = args.eval_data_dir
+            eval_batches = image_batches(eval_args, (32, 32, 3), ds, eval_mode=True)
+            split = "heldout"
+        elif args.data_dir:
+            # No separate split staged: an unshuffled pass over the
+            # TRAINING records — labeled as such so it is never mistaken
+            # for held-out accuracy.
             eval_batches = image_batches(args, (32, 32, 3), ds, eval_mode=True)
+            split = "train"
         else:
             # Synthetic: same task (template_seed matches the training
             # templates), disjoint sample stream.
@@ -115,9 +132,17 @@ def main(argv: list[str] | None = None) -> dict:
                 seed=10_000, template_seed=0,
             )
             eval_batches = eval_ds.batches
-        result["eval"] = trainer.evaluate(
-            state, eval_batches(args.eval_steps), steps=args.eval_steps
-        )
+            split = "heldout"
+        result["eval"] = {
+            "split": split,
+            **trainer.evaluate(
+                state, eval_batches(args.eval_steps), steps=args.eval_steps
+            ),
+        }
+        if sink is not None:
+            sink.write({"event": "eval", "run": args.model, **result["eval"]})
+    if sink is not None:
+        sink.close()
     return result
 
 

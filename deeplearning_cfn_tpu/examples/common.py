@@ -51,7 +51,22 @@ def base_parser(description: str) -> argparse.ArgumentParser:
              "in order, like the reference's FSx->EFS->EBS probe); unset = "
              "synthetic data",
     )
+    p.add_argument(
+        "--metrics_dir",
+        default=os.environ.get("DLCFN_METRICS_DIR"),
+        help="dir for structured per-worker JSONL metrics (typically the "
+             "shared storage mount; the per-rank-logs-on-EFS analog)",
+    )
     return p
+
+
+def metrics_sink(args, run_name: str):
+    """JsonlMetricsSink for --metrics_dir, or None."""
+    if not getattr(args, "metrics_dir", None):
+        return None
+    from deeplearning_cfn_tpu.train.metrics import JsonlMetricsSink
+
+    return JsonlMetricsSink.for_run(args.metrics_dir, run_name)
 
 
 def image_batches(args, image_shape, fallback_ds, eval_mode: bool = False):

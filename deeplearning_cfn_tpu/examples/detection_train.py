@@ -22,6 +22,7 @@ from deeplearning_cfn_tpu.examples.common import (
     base_parser,
     default_mesh,
     maybe_init_distributed,
+    metrics_sink,
 )
 from deeplearning_cfn_tpu.models import retinanet
 from deeplearning_cfn_tpu.train.data import SyntheticDetectionDataset
@@ -96,7 +97,8 @@ def main(argv: list[str] | None = None) -> dict:
     sample = next(iter(ds.batches(1)))
     state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
     logger = ThroughputLogger(
-        global_batch_size=batch, log_every=args.log_every, name="detection"
+        global_batch_size=batch, log_every=args.log_every, name="detection",
+        sink=metrics_sink(args, "detection"),
     )
     state, losses = trainer.fit(
         state, ds.batches(args.steps), steps=args.steps, logger=logger

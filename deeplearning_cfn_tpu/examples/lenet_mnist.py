@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from deeplearning_cfn_tpu.examples.common import base_parser, default_mesh, maybe_init_distributed
 from deeplearning_cfn_tpu.models.lenet import LeNet
 from deeplearning_cfn_tpu.train.data import SyntheticDataset
+from deeplearning_cfn_tpu.examples.common import metrics_sink
 from deeplearning_cfn_tpu.train.metrics import ThroughputLogger
 from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
 
@@ -40,7 +41,8 @@ def main(argv: list[str] | None = None) -> dict:
     ds = SyntheticDataset.mnist_like(batch_size=batch)
     sample = next(iter(ds.batches(1)))
     state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
-    logger = ThroughputLogger(global_batch_size=batch, log_every=args.log_every, name="lenet")
+    _sink = metrics_sink(args, 'lenet')
+    logger = ThroughputLogger(global_batch_size=batch, log_every=args.log_every, name="lenet", sink=_sink)
     state, losses = trainer.fit(state, ds.batches(args.steps), steps=args.steps, logger=logger)
     return {"final_loss": losses[-1], "steps": len(losses)}
 

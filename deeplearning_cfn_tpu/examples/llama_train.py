@@ -18,6 +18,7 @@ from deeplearning_cfn_tpu.models import llama
 from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
 from deeplearning_cfn_tpu.train.checkpoint import Checkpointer
 from deeplearning_cfn_tpu.train.data import SyntheticTokenDataset
+from deeplearning_cfn_tpu.examples.common import metrics_sink
 from deeplearning_cfn_tpu.train.metrics import ThroughputLogger
 from deeplearning_cfn_tpu.train.trainer import TrainerConfig
 
@@ -83,8 +84,9 @@ def main(argv: list[str] | None = None) -> dict:
         restored = ckpt.restore_latest(state)
         if restored is not None:
             state, _ = restored
+    _sink = metrics_sink(args, 'llama')
     logger = ThroughputLogger(
-        global_batch_size=batch * args.seq_len, log_every=args.log_every, name="llama"
+        global_batch_size=batch * args.seq_len, log_every=args.log_every, name="llama", sink=_sink
     )
     state, losses = trainer.fit(
         state, ds.batches(args.steps), steps=args.steps, logger=logger, checkpointer=ckpt

@@ -20,6 +20,7 @@ from deeplearning_cfn_tpu.examples.common import (
     default_mesh,
     image_batches,
     maybe_init_distributed,
+    metrics_sink,
 )
 from deeplearning_cfn_tpu.models.resnet import ResNet50, ResNet101, ResNet152
 from deeplearning_cfn_tpu.train.data import SyntheticDataset
@@ -55,7 +56,8 @@ def main(argv: list[str] | None = None) -> dict:
     sample = next(iter(batches(1)))
     state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
     logger = ThroughputLogger(
-        global_batch_size=batch, log_every=args.log_every, name=f"resnet{args.depth}"
+        global_batch_size=batch, log_every=args.log_every,
+        name=f"resnet{args.depth}", sink=metrics_sink(args, f"resnet{args.depth}"),
     )
     state, losses = trainer.fit(state, batches(args.steps), steps=args.steps, logger=logger)
     return {"final_loss": losses[-1], "steps": len(losses), "history": logger.history}

@@ -73,6 +73,25 @@ class BertLayer(nn.Module):
         return nn.LayerNorm(dtype=jnp.float32, name="mlp_ln")(x + mlp)
 
 
+def _encoder_trunk(
+    cfg: BertConfig, tokens: jnp.ndarray, deterministic: bool
+) -> tuple[jnp.ndarray, nn.Embed]:
+    """Shared embed+layers trunk.  Submodule names are created on the
+    CALLING module, so BertEncoder and BertClassifier produce identical
+    trunk parameter trees — a pretrain checkpoint transfers by key
+    intersection (transfer_trunk_params)."""
+    S = tokens.shape[1]
+    embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype, name="tok_embed")
+    x = embed(tokens)
+    pos = nn.Embed(cfg.max_seq_len, cfg.dim, dtype=cfg.dtype, name="pos_embed")(
+        jnp.arange(S)[None, :]
+    )
+    x = nn.LayerNorm(dtype=jnp.float32, name="embed_ln")(x + pos)
+    for i in range(cfg.n_layers):
+        x = BertLayer(cfg, name=f"layer{i}")(x, deterministic=deterministic)
+    return x, embed
+
+
 class BertEncoder(nn.Module):
     cfg: BertConfig = field(default_factory=BertConfig)
 
@@ -80,21 +99,46 @@ class BertEncoder(nn.Module):
     def __call__(self, tokens: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
         """tokens [B, S] -> MLM logits [B, S, vocab] (f32)."""
         cfg = self.cfg
-        B, S = tokens.shape
-        embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype, name="tok_embed")
-        x = embed(tokens)
-        pos = nn.Embed(cfg.max_seq_len, cfg.dim, dtype=cfg.dtype, name="pos_embed")(
-            jnp.arange(S)[None, :]
-        )
-        x = nn.LayerNorm(dtype=jnp.float32, name="embed_ln")(x + pos)
-        for i in range(cfg.n_layers):
-            x = BertLayer(cfg, name=f"layer{i}")(x, deterministic=deterministic)
+        x, embed = _encoder_trunk(cfg, tokens, deterministic)
         # MLM head: transform + tied output embedding.
         x = nn.Dense(cfg.dim, dtype=cfg.dtype, name="mlm_transform")(x)
         x = nn.gelu(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(x)
         logits = embed.attend(x.astype(cfg.dtype))
         return logits.astype(jnp.float32)
+
+
+class BertClassifier(nn.Module):
+    """Sequence classification head over the shared trunk (the GLUE-style
+    fine-tuning surface): first-token pooling -> tanh pooler -> logits."""
+
+    cfg: BertConfig = field(default_factory=BertConfig)
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        """tokens [B, S] -> class logits [B, num_classes] (f32)."""
+        cfg = self.cfg
+        x, _ = _encoder_trunk(cfg, tokens, deterministic)
+        pooled = jnp.tanh(
+            nn.Dense(cfg.dim, dtype=cfg.dtype, name="pooler")(x[:, 0])
+        )
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32, name="classifier")(
+            pooled.astype(jnp.float32)
+        )
+        return logits
+
+
+def transfer_trunk_params(pretrained: dict, target: dict) -> dict:
+    """Copy every trunk parameter subtree present in BOTH trees (tok_embed,
+    pos_embed, embed_ln, layer*) from a pretrained tree into a target
+    (e.g. freshly-initialized classifier) tree.  Head params absent from
+    either side keep the target's initialization."""
+    out = dict(target)
+    for key, value in pretrained.items():
+        if key in out:
+            out[key] = value
+    return out
 
 
 def mlm_loss(model: BertEncoder):

@@ -122,9 +122,10 @@ class Backend:
         """Returns True if deleted; False if retained by policy."""
         raise NotImplementedError
 
-    def storage_exists(self, storage_id: str) -> bool:
+    def storage_exists(self, storage_id: str, kind: str = "filestore") -> bool:
         """Whether retained storage is still present (recover() checks
-        before reusing)."""
+        before reusing).  ``kind`` selects the API surface to probe (e.g.
+        filestore instance vs GCS bucket)."""
         raise NotImplementedError
 
     # --- stack signaling (WaitCondition / signal_resource analog) ------

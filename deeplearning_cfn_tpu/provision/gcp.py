@@ -337,13 +337,18 @@ class GCPBackend(Backend):
         self.transport("DELETE", f"b/{storage_id}", None)
         return True
 
-    def storage_exists(self, storage_id: str) -> bool:
+    def storage_exists(self, storage_id: str, kind: str = "filestore") -> bool:
         # Only a not-found (KeyError, the transport convention shared with
         # LocalBackend) means "gone"; transient API errors must propagate —
         # treating a 503 as "deleted" would make recover() abandon live
-        # checkpoints.
+        # checkpoints.  Path dispatch mirrors create_or_reuse_storage.
+        path = (
+            f"projects/{self.project}/locations/{self.zone}/instances/{storage_id}"
+            if kind == "filestore"
+            else f"b/{storage_id}"
+        )
         try:
-            self.transport("GET", f"b/{storage_id}", None)
+            self.transport("GET", path, None)
             return True
         except KeyError:
             return False

@@ -220,7 +220,7 @@ class LocalBackend(Backend):
         del self._storage[storage_id]
         return True
 
-    def storage_exists(self, storage_id: str) -> bool:
+    def storage_exists(self, storage_id: str, kind: str = "filestore") -> bool:
         return storage_id in self._storage
 
     # --- signaling -------------------------------------------------------

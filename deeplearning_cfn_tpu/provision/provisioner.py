@@ -135,6 +135,10 @@ class Provisioner:
             "created" if self._storage.created else "reused",
             self._storage.mount_point,
         )
+        # Record the binding as soon as the storage exists — a crash later
+        # in provisioning must not leave retained storage undiscoverable
+        # by a fresh-process recover().
+        self._record_storage()
 
         # Creating the group fires INSTANCE_LAUNCH / INSTANCE_LAUNCH_ERROR
         # events into the controller (the ASG -> SNS -> Lambda path).
@@ -146,7 +150,6 @@ class Provisioner:
         )
 
         contract = self._run_bootstrap(coord_q, worker_q)
-        self._record_storage()
         result = ProvisionResult(
             spec=spec,
             contract=contract,
@@ -341,7 +344,9 @@ class Provisioner:
             else (self._read_storage_record() or self.spec.storage.existing_id)
         )
         self.delete(force_storage=False)
-        if retained is not None and self.backend.storage_exists(retained):
+        if retained is not None and self.backend.storage_exists(
+            retained, self.spec.storage.kind
+        ):
             self.spec = _dc.replace(
                 self.spec,
                 storage=_dc.replace(self.spec.storage, existing_id=retained),

@@ -149,6 +149,45 @@ class SyntheticMLMDataset:
 
 
 @dataclass
+class SyntheticSeqClassificationDataset:
+    """Labeled token sequences for classifier fine-tuning smokes: each
+    class has its own categorical distribution over the vocabulary
+    (template logits), so labels are learnable from token statistics but
+    not trivially from any single position.  ``template_seed`` follows the
+    SyntheticDataset convention (same task, disjoint sample streams)."""
+
+    batch_size: int = 32
+    seq_len: int = 32
+    vocab_size: int = 64
+    num_classes: int = 4
+    seed: int = 0
+    template_seed: int | None = None
+
+    def batches(self, steps: int) -> Iterator[Batch]:
+        rng = np.random.default_rng(self.seed)
+        template_rng = (
+            np.random.default_rng(self.template_seed)
+            if self.template_seed is not None
+            else rng
+        )
+        logits = 2.0 * template_rng.standard_normal(
+            (self.num_classes, self.vocab_size)
+        )
+        probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        for _ in range(steps):
+            y = rng.integers(0, self.num_classes, size=self.batch_size).astype(
+                np.int32
+            )
+            x = np.stack(
+                [
+                    rng.choice(self.vocab_size, size=self.seq_len, p=probs[label])
+                    for label in y
+                ]
+            ).astype(np.int32)
+            yield Batch(x=x, y=y)
+
+
+@dataclass
 class SyntheticDetectionDataset:
     """Synthetic detection batches: images containing colored rectangles,
     one color template per class, with padded ground truth —

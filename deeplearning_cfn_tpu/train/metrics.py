@@ -9,8 +9,10 @@ window.
 from __future__ import annotations
 
 import contextlib
+import json
 import time
 from dataclasses import dataclass, field
+from pathlib import Path
 
 import jax
 
@@ -20,10 +22,50 @@ log = get_logger("dlcfn.train")
 
 
 @dataclass
+class JsonlMetricsSink:
+    """Structured per-worker metrics stream on (shared) storage — the
+    analog of the reference's per-rank training logs collected on EFS
+    (mpirun --output-filename, run.sh:82), machine-readable instead of
+    free text.  One JSONL file per process; every record carries the
+    wallclock and process index so multi-worker runs collate trivially.
+    """
+
+    path: str | Path
+    _fh: object = field(default=None, repr=False)
+
+    def __post_init__(self) -> None:
+        p = Path(self.path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        self._fh = open(p, "a", buffering=1)  # line-buffered
+
+    def write(self, record: dict) -> None:
+        self._fh.write(
+            json.dumps(
+                {"ts": time.time(), "process": jax.process_index(), **record}
+            )
+            + "\n"
+        )
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    @classmethod
+    def for_run(cls, base_dir: str | Path, run_name: str) -> "JsonlMetricsSink":
+        """<base>/<run>/worker<pid>.jsonl, base typically the cluster's
+        shared storage mount."""
+        return cls(
+            Path(base_dir) / run_name / f"worker{jax.process_index()}.jsonl"
+        )
+
+
+@dataclass
 class ThroughputLogger:
     global_batch_size: int
     log_every: int = 10
     name: str = "train"
+    sink: JsonlMetricsSink | None = None
     _t0: float = field(default_factory=time.perf_counter)
     _last_step: int = 0
     history: list[dict] = field(default_factory=list)
@@ -42,6 +84,8 @@ class ThroughputLogger:
             "examples_per_sec": examples_per_sec,
         }
         self.history.append(record)
+        if self.sink is not None:
+            self.sink.write({"event": "train_step", "run": self.name, **record})
         log.info(
             "%s step=%d loss=%.4f examples/sec=%.1f",
             self.name,

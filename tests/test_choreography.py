@@ -281,3 +281,16 @@ def test_recover_from_fresh_process_reads_storage_record(contract_root):
     recovered = fresh.recover()
     assert recovered.storage.storage_id == storage_id
     assert not recovered.storage.created
+
+
+def test_storage_record_written_before_bootstrap(contract_root):
+    """Regression: the durable storage record must exist as soon as the
+    storage does — a crash during bootstrap must not orphan it."""
+    backend = LocalBackend(
+        clock=FakeClock(), fail_instance_indices={GROUP: {0, 1}}
+    )
+    spec = make_spec(workers=2)  # all launches fail -> ProvisionFailure
+    prov = Provisioner(backend, spec, contract_root=contract_root)
+    with pytest.raises(Exception):
+        prov.provision()
+    assert (contract_root / "storage.json").exists()
