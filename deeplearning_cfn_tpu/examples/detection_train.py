@@ -45,6 +45,8 @@ def main(argv: list[str] | None = None) -> dict:
     p.add_argument("--bf16", action=argparse.BooleanOptionalAction, default=True)
     p.add_argument("--freeze_backbone_norm", action="store_true")
     p.add_argument("--optimizer", choices=["momentum", "adamw"], default="momentum")
+    p.add_argument("--eval_steps", type=int, default=0,
+                   help="held-out batches for mAP@0.5 after training (0 = skip)")
     args = p.parse_args(argv)
     maybe_init_distributed()
     if args.image_size % 32:
@@ -103,7 +105,60 @@ def main(argv: list[str] | None = None) -> dict:
     state, losses = trainer.fit(
         state, ds.batches(args.steps), steps=args.steps, logger=logger
     )
-    return {"final_loss": losses[-1], "steps": len(losses), "history": logger.history}
+    result = {"final_loss": losses[-1], "steps": len(losses), "history": logger.history}
+    if args.eval_steps:
+        result["eval"] = evaluate_map(
+            model, trainer, state, anchors, args, batch, steps=args.eval_steps
+        )
+    return result
+
+
+def evaluate_map(model, trainer, state, anchors, args, batch, steps: int) -> dict:
+    """mAP@0.5 on a held-out synthetic stream (same class->color templates
+    as training, disjoint samples): batched eval forward + fixed-shape
+    predict on device, greedy matching/AP host-side.
+
+    Host-side accumulation needs the detections on one host, so this path
+    is single-controller; multi-process runs skip it with a log.
+    """
+    from deeplearning_cfn_tpu.train.detection_eval import DetectionAccumulator
+    from deeplearning_cfn_tpu.utils.logging import get_logger
+
+    if jax.process_count() > 1:
+        get_logger("dlcfn.examples").warning(
+            "mAP evaluation is single-controller; skipping on %d processes",
+            jax.process_count(),
+        )
+        return {}
+
+    @jax.jit
+    def infer(params, model_state, x):
+        variables = {"params": params, **model_state}
+        cls_out, box_out = model.apply(variables, x, train=False)
+        return jax.vmap(
+            lambda c, b: retinanet.predict(c, b, anchors, max_detections=50)
+        )(cls_out, box_out)
+
+    held_out = SyntheticDetectionDataset(
+        image_size=args.image_size, num_classes=args.num_classes,
+        max_boxes=args.max_boxes, batch_size=batch,
+        seed=7_000, template_seed=0,
+    )
+    acc = DetectionAccumulator(num_classes=args.num_classes)
+    for batch_data in held_out.batches(steps):
+        x = jax.device_put(batch_data.x, trainer.batch_sharding)
+        with jax.set_mesh(trainer.mesh):
+            dets = jax.device_get(infer(state.params, state.model_state, x))
+        for i in range(len(batch_data.x)):
+            acc.add_image(
+                dets["boxes"][i], dets["scores"][i], dets["classes"][i],
+                dets["valid"][i], batch_data.y["boxes"][i],
+                batch_data.y["classes"][i],
+            )
+    out = acc.result()
+    # per_class_ap keys to str for JSON friendliness
+    out["per_class_ap"] = {str(k): v for k, v in out["per_class_ap"].items()}
+    return out
 
 
 if __name__ == "__main__":

@@ -201,10 +201,21 @@ class SyntheticDetectionDataset:
     max_boxes: int = 5
     batch_size: int = 8
     seed: int = 0
+    # Class->color templates define the TASK (same convention as
+    # SyntheticDataset.template_seed): held-out splits share template_seed
+    # with training but use a different seed.
+    template_seed: int | None = None
 
     def batches(self, steps: int) -> Iterator[Batch]:
         rng = np.random.default_rng(self.seed)
-        colors = rng.uniform(0.5, 1.5, size=(self.num_classes, 3)).astype(np.float32)
+        template_rng = (
+            np.random.default_rng(self.template_seed)
+            if self.template_seed is not None
+            else rng
+        )
+        colors = template_rng.uniform(
+            0.5, 1.5, size=(self.num_classes, 3)
+        ).astype(np.float32)
         s = self.image_size
         for _ in range(steps):
             x = rng.normal(0.0, 0.05, size=(self.batch_size, s, s, 3)).astype(

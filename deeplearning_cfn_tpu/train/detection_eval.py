@@ -1,0 +1,121 @@
+"""Detection evaluation — per-class average precision (mAP).
+
+The reference's detection workload (Mask R-CNN, C9) reported COCO metrics
+through its external framework; this is the framework-native equivalent
+for the TPU-first detection path (models/retinanet).  Device side stays
+static-shape (`retinanet.predict` emits fixed-size [D] detection slots
+with a `valid` mask); matching and AP run host-side in numpy, where
+variable-length bookkeeping is natural and off the accelerator's critical
+path.
+
+Matching is the standard greedy protocol: per class, detections sorted by
+score claim the not-yet-matched ground-truth box with the highest IoU
+above the threshold (TP), otherwise count as FP; AP is area under the
+interpolated precision-recall curve (all-points), mAP the mean over
+classes with ground truth.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+def box_iou_np(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """IoU of [N, 4] x [M, 4] boxes (y1, x1, y2, x2)."""
+    if len(a) == 0 or len(b) == 0:
+        return np.zeros((len(a), len(b)), np.float32)
+    y1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    x1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    y2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    x2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = np.clip(y2 - y1, 0, None) * np.clip(x2 - x1, 0, None)
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    union = area_a[:, None] + area_b[None, :] - inter
+    return (inter / np.maximum(union, 1e-9)).astype(np.float32)
+
+
+def average_precision(recall: np.ndarray, precision: np.ndarray) -> float:
+    """All-points interpolated AP (PASCAL VOC 2010+ convention)."""
+    r = np.concatenate([[0.0], recall, [1.0]])
+    p = np.concatenate([[0.0], precision, [0.0]])
+    # precision envelope (monotone non-increasing from the right)
+    for i in range(len(p) - 2, -1, -1):
+        p[i] = max(p[i], p[i + 1])
+    idx = np.where(r[1:] != r[:-1])[0]
+    return float(np.sum((r[idx + 1] - r[idx]) * p[idx + 1]))
+
+
+@dataclass
+class DetectionAccumulator:
+    """Streaming mAP: feed per-image predictions + ground truth, then
+    :meth:`result`.  Predictions use retinanet.predict's fixed-shape
+    contract (``valid`` masks empty slots); ground truth uses the padded
+    dataset contract (class -1 = padding)."""
+
+    num_classes: int
+    iou_threshold: float = 0.5
+    # per class: list of (score, is_tp)
+    _dets: dict[int, list[tuple[float, bool]]] = field(default_factory=dict)
+    _gt_count: dict[int, int] = field(default_factory=dict)
+    images: int = 0
+
+    def add_image(
+        self,
+        pred_boxes: np.ndarray,    # [D, 4]
+        pred_scores: np.ndarray,   # [D]
+        pred_classes: np.ndarray,  # [D]
+        pred_valid: np.ndarray,    # [D] bool-ish
+        gt_boxes: np.ndarray,      # [M, 4] (zero-padded)
+        gt_classes: np.ndarray,    # [M] (-1 = padding)
+    ) -> None:
+        self.images += 1
+        keep = np.asarray(pred_valid).astype(bool)
+        pred_boxes = np.asarray(pred_boxes)[keep]
+        pred_scores = np.asarray(pred_scores)[keep]
+        pred_classes = np.asarray(pred_classes)[keep]
+        real = np.asarray(gt_classes) >= 0
+        gt_boxes = np.asarray(gt_boxes)[real]
+        gt_classes = np.asarray(gt_classes)[real]
+
+        for c in np.unique(np.concatenate([pred_classes, gt_classes])).tolist():
+            c = int(c)
+            gt_c = gt_boxes[gt_classes == c]
+            self._gt_count[c] = self._gt_count.get(c, 0) + len(gt_c)
+            det_mask = pred_classes == c
+            det_boxes = pred_boxes[det_mask]
+            det_scores = pred_scores[det_mask]
+            order = np.argsort(-det_scores)
+            det_boxes, det_scores = det_boxes[order], det_scores[order]
+            iou = box_iou_np(det_boxes, gt_c)
+            matched = np.zeros(len(gt_c), bool)
+            bucket = self._dets.setdefault(c, [])
+            for i in range(len(det_boxes)):
+                tp = False
+                if len(gt_c):
+                    j = int(np.argmax(np.where(matched, -1.0, iou[i])))
+                    if not matched[j] and iou[i, j] >= self.iou_threshold:
+                        matched[j] = True
+                        tp = True
+                bucket.append((float(det_scores[i]), tp))
+
+    def result(self) -> dict:
+        """{"mAP": float, "per_class_ap": {class: ap}, "images": n}."""
+        per_class = {}
+        for c, n_gt in self._gt_count.items():
+            if n_gt == 0:
+                continue
+            dets = sorted(self._dets.get(c, []), key=lambda t: -t[0])
+            if not dets:
+                per_class[c] = 0.0
+                continue
+            tps = np.array([tp for _, tp in dets], np.float32)
+            tp_cum = np.cumsum(tps)
+            fp_cum = np.cumsum(1.0 - tps)
+            recall = tp_cum / n_gt
+            precision = tp_cum / np.maximum(tp_cum + fp_cum, 1e-9)
+            per_class[c] = average_precision(recall, precision)
+        mAP = float(np.mean(list(per_class.values()))) if per_class else 0.0
+        return {"mAP": mAP, "per_class_ap": per_class, "images": self.images}

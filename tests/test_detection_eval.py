@@ -1,0 +1,126 @@
+"""Detection mAP evaluation (train/detection_eval)."""
+
+import numpy as np
+import pytest
+
+from deeplearning_cfn_tpu.train.detection_eval import (
+    DetectionAccumulator,
+    average_precision,
+    box_iou_np,
+)
+
+
+def _img(boxes, classes):
+    boxes = np.asarray(boxes, np.float32).reshape(-1, 4)
+    classes = np.asarray(classes, np.int32)
+    return boxes, classes
+
+
+def test_box_iou_np():
+    a = np.array([[0, 0, 10, 10]], np.float32)
+    b = np.array([[0, 0, 10, 10], [5, 5, 15, 15], [20, 20, 30, 30]], np.float32)
+    iou = box_iou_np(a, b)[0]
+    assert iou[0] == pytest.approx(1.0)
+    assert iou[1] == pytest.approx(25 / 175)
+    assert iou[2] == 0.0
+
+
+def test_average_precision_extremes():
+    # All TPs in order -> AP 1.0
+    assert average_precision(np.array([0.5, 1.0]), np.array([1.0, 1.0])) == pytest.approx(1.0)
+    # Zero precision everywhere -> 0
+    assert average_precision(np.array([0.0]), np.array([0.0])) == 0.0
+
+
+def test_perfect_predictions_map_1():
+    acc = DetectionAccumulator(num_classes=3)
+    gt_boxes, gt_classes = _img([[0, 0, 10, 10], [20, 20, 40, 40]], [0, 2])
+    acc.add_image(
+        pred_boxes=gt_boxes, pred_scores=np.array([0.9, 0.8]),
+        pred_classes=gt_classes, pred_valid=np.array([1, 1]),
+        gt_boxes=gt_boxes, gt_classes=gt_classes,
+    )
+    out = acc.result()
+    assert out["mAP"] == pytest.approx(1.0)
+    assert set(out["per_class_ap"]) == {0, 2}
+
+
+def test_wrong_class_is_fp_and_missed_gt():
+    acc = DetectionAccumulator(num_classes=3)
+    gt_boxes, gt_classes = _img([[0, 0, 10, 10]], [1])
+    acc.add_image(
+        pred_boxes=gt_boxes, pred_scores=np.array([0.9]),
+        pred_classes=np.array([0]),  # wrong class
+        pred_valid=np.array([1]),
+        gt_boxes=gt_boxes, gt_classes=gt_classes,
+    )
+    out = acc.result()
+    assert out["mAP"] == 0.0  # class 1 has a GT but no detections
+
+
+def test_duplicate_detections_count_once():
+    """Two detections on one GT: the second is a FP (greedy matching)."""
+    acc = DetectionAccumulator(num_classes=2)
+    gt_boxes, gt_classes = _img([[0, 0, 10, 10]], [0])
+    acc.add_image(
+        pred_boxes=np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32),
+        pred_scores=np.array([0.9, 0.8]),
+        pred_classes=np.array([0, 0]), pred_valid=np.array([1, 1]),
+        gt_boxes=gt_boxes, gt_classes=gt_classes,
+    )
+    out = acc.result()
+    # AP: first det TP (p=1, r=1), second FP (p=0.5) -> all-points AP = 1.0
+    assert out["per_class_ap"][0] == pytest.approx(1.0)
+
+
+def test_low_iou_is_fp():
+    acc = DetectionAccumulator(num_classes=2, iou_threshold=0.5)
+    gt_boxes, gt_classes = _img([[0, 0, 10, 10]], [0])
+    acc.add_image(
+        pred_boxes=np.array([[8, 8, 18, 18]], np.float32),  # IoU ~ 0.02
+        pred_scores=np.array([0.9]),
+        pred_classes=np.array([0]), pred_valid=np.array([1]),
+        gt_boxes=gt_boxes, gt_classes=gt_classes,
+    )
+    assert acc.result()["mAP"] == 0.0
+
+
+def test_padding_and_invalid_slots_ignored():
+    acc = DetectionAccumulator(num_classes=2)
+    acc.add_image(
+        pred_boxes=np.array([[0, 0, 10, 10], [0, 0, 0, 0]], np.float32),
+        pred_scores=np.array([0.9, 0.0]),
+        pred_classes=np.array([0, 0]),
+        pred_valid=np.array([1, 0]),  # slot 2 invalid
+        gt_boxes=np.array([[0, 0, 10, 10], [0, 0, 0, 0]], np.float32),
+        gt_classes=np.array([0, -1]),  # slot 2 padding
+    )
+    out = acc.result()
+    assert out["mAP"] == pytest.approx(1.0)
+    assert acc._gt_count[0] == 1
+
+
+def test_ranking_matters():
+    """A high-scoring FP above the TP lowers AP below 1."""
+    acc = DetectionAccumulator(num_classes=2)
+    gt_boxes, gt_classes = _img([[0, 0, 10, 10]], [0])
+    acc.add_image(
+        pred_boxes=np.array([[50, 50, 60, 60], [0, 0, 10, 10]], np.float32),
+        pred_scores=np.array([0.95, 0.6]),  # FP outranks TP
+        pred_classes=np.array([0, 0]), pred_valid=np.array([1, 1]),
+        gt_boxes=gt_boxes, gt_classes=gt_classes,
+    )
+    ap = acc.result()["per_class_ap"][0]
+    assert ap == pytest.approx(0.5)  # precision 1/2 at recall 1
+
+
+def test_streaming_over_multiple_images():
+    acc = DetectionAccumulator(num_classes=2)
+    g1 = _img([[0, 0, 10, 10]], [0])
+    g2 = _img([[5, 5, 15, 15]], [0])
+    for boxes, classes in (g1, g2):
+        acc.add_image(boxes, np.array([0.9]), classes, np.array([1]), boxes, classes)
+    out = acc.result()
+    assert out["images"] == 2
+    assert out["mAP"] == pytest.approx(1.0)
+    assert acc._gt_count[0] == 2
