@@ -15,8 +15,12 @@ training launch; see /root/reference) designed for TPU hardware:
 - Launch: one SPMD program on all workers over `jax.distributed` — no SSH
   fan-out, no MPI, no parameter servers.  Replaces run.sh / mpirun /
   generate_trainer.py.
-- Compute: JAX/XLA/pjit data-parallel + FSDP + tensor/sequence parallel
-  trainers over a `jax.sharding.Mesh`; collectives ride ICI, not NCCL.
+- Compute: JAX/XLA/pjit trainers over a `jax.sharding.Mesh` with the full
+  parallelism surface — data parallel, FSDP, tensor, sequence (ring
+  attention), pipeline (GPipe over ppermute), expert (MoE), and hybrid
+  DCN x ICI meshes for multi-slice; collectives ride ICI, not NCCL.
+- IO: a native C++ record loader (fixed-size DLC1 records, threaded
+  shuffling reads) keeps the accelerator off per-example Python.
 """
 
 __version__ = "0.1.0"
@@ -27,3 +31,7 @@ from deeplearning_cfn_tpu.config.schema import (  # noqa: F401
     StorageSpec,
     NodePool,
 )
+
+# Compute-path entry points (Trainer, MeshSpec, models, ...) are imported
+# from their submodules directly — the package root stays importable
+# without jax so control-plane-only tools don't pay the import.
