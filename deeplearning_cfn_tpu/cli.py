@@ -55,27 +55,62 @@ def _load_spec(args) -> ClusterSpec:
         raise SystemExit(f"template error: {e}") from e
 
 
-def _backend_for(spec: ClusterSpec):
+def _parse_broker(broker: str) -> tuple[str, int]:
+    host, _, port_str = broker.rpartition(":")
+    try:
+        port = int(port_str)
+    except ValueError:
+        port = -1
+    if not host or not (0 < port < 65536):
+        raise SystemExit(f"--broker expects HOST:PORT, got {broker!r}")
+    return host, port
+
+
+def _backend_for(spec: ClusterSpec, broker: str | None = None):
+    broker_addr = _parse_broker(broker) if broker else None
     if spec.backend == "local":
         from deeplearning_cfn_tpu.provision.local import LocalBackend
 
-        return LocalBackend()
-    from deeplearning_cfn_tpu.cluster.startup import render_startup_script
-    from deeplearning_cfn_tpu.provision.gcp import GCPBackend
+        backend = LocalBackend()
+    else:
+        from deeplearning_cfn_tpu.cluster.startup import render_startup_script
+        from deeplearning_cfn_tpu.provision.gcp import GCPBackend
 
-    return GCPBackend(
-        project=spec.project,
-        zone=spec.zone,
-        accelerator_type=spec.pool.accelerator_type,
-        runtime_version=spec.pool.image_override or spec.pool.runtime_version,
-        network=spec.network.network,
-        subnetwork=spec.network.subnetwork,
-        external_ips=spec.network.external_ips,
-        disk_size_gb=spec.pool.disk_size_gb,
-        disk_type=spec.pool.disk_type,
-        spot=spec.pool.spot,
-        startup_script=render_startup_script(spec),
-    )
+        backend = GCPBackend(
+            project=spec.project,
+            zone=spec.zone,
+            accelerator_type=spec.pool.accelerator_type,
+            runtime_version=spec.pool.image_override or spec.pool.runtime_version,
+            network=spec.network.network,
+            subnetwork=spec.network.subnetwork,
+            external_ips=spec.network.external_ips,
+            disk_size_gb=spec.pool.disk_size_gb,
+            disk_type=spec.pool.disk_type,
+            spot=spec.pool.spot,
+            startup_script=render_startup_script(spec),
+            # Stamped into VM metadata (dlcfn-broker) so the startup
+            # script can hand agents their control plane.
+            broker_host=broker_addr[0] if broker_addr else None,
+            broker_port=broker_addr[1] if broker_addr else 8477,
+        )
+    if broker_addr:
+        # Production topology: agents run on the VMs and rendezvous through
+        # the broker; this process is the CloudFormation-engine side.
+        from deeplearning_cfn_tpu.cluster.broker_backend import (
+            BrokerRendezvousBackend,
+        )
+
+        try:
+            backend = BrokerRendezvousBackend(backend, *broker_addr)
+        except OSError as e:
+            raise SystemExit(f"cannot reach broker at {broker}: {e}") from e
+    return backend
+
+
+def _progress_printer(elapsed_s: float, status: str) -> None:
+    # The stack drivers' poll loop printing elapsed time every 30 s
+    # (mask-rcnn-stack.sh:84-92).
+    print(f"  CREATE_IN_PROGRESS {elapsed_s:.0f}s elapsed: {status}", file=sys.stderr)
 
 
 def cmd_validate(args) -> int:
@@ -93,14 +128,19 @@ def cmd_create(args) -> int:
     from deeplearning_cfn_tpu.provision.provisioner import ProvisionFailure, Provisioner
 
     spec = _load_spec(args)
-    backend = _backend_for(spec)
-    prov = Provisioner(backend, spec)
+    broker = getattr(args, "broker", None)
+    backend = _backend_for(spec, broker)
+    prov = Provisioner(
+        backend,
+        spec,
+        remote_agents=bool(broker),
+        progress=_progress_printer,
+    )
     t0 = time.monotonic()
     print(f"creating cluster {spec.name!r}...", file=sys.stderr)
     try:
-        # The stack drivers poll every 30 s printing elapsed time
-        # (mask-rcnn-stack.sh:84-92); the local backend provisions inline so
-        # elapsed time is printed once at completion.
+        # Inline (local) backends provision synchronously; with --broker the
+        # provisioner polls, calling _progress_printer each tick.
         result = prov.provision()
     except ProvisionFailure as e:
         print(f"CREATE FAILED after {time.monotonic() - t0:.0f}s: {e}", file=sys.stderr)
@@ -156,8 +196,11 @@ def cmd_recover(args) -> int:
     from deeplearning_cfn_tpu.provision.provisioner import ProvisionFailure, Provisioner
 
     spec = _load_spec(args)
-    backend = _backend_for(spec)
-    prov = Provisioner(backend, spec)
+    broker = getattr(args, "broker", None)
+    backend = _backend_for(spec, broker)
+    prov = Provisioner(
+        backend, spec, remote_agents=bool(broker), progress=_progress_printer
+    )
     t0 = time.monotonic()
     print(f"recovering cluster {spec.name!r}...", file=sys.stderr)
     try:
@@ -322,8 +365,11 @@ def cmd_run(args) -> int:
     from deeplearning_cfn_tpu.provision.provisioner import ProvisionFailure, Provisioner
 
     spec = _load_spec(args)
-    backend = _backend_for(spec)
-    prov = Provisioner(backend, spec)
+    broker = getattr(args, "broker", None)
+    backend = _backend_for(spec, broker)
+    prov = Provisioner(
+        backend, spec, remote_agents=bool(broker), progress=_progress_printer
+    )
     try:
         result = prov.provision()
         plan = build_launch_plan(result.contract, spec.job, result.job_violation)
@@ -371,6 +417,14 @@ def main(argv: list[str] | None = None) -> int:
             default=[],
             help="template parameter override key=value (repeatable)",
         )
+        if name in ("create", "run", "recover"):
+            p.add_argument(
+                "--broker",
+                default=None,
+                metavar="HOST:PORT",
+                help="rendezvous broker address; bootstrap agents run on the "
+                "VMs (production topology) instead of inline",
+            )
         if name == "delete":
             p.add_argument("--force-storage", action="store_true")
         if name == "stage":

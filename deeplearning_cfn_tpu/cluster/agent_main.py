@@ -1,21 +1,34 @@
 """On-VM bootstrap agent entry point — what every TPU VM runs at boot.
 
 The cfn-init/UserData analog (deeplearning.template:490-516): the queued
-resource's startup-script runs this module on every worker VM.  Role and
-rendezvous come from instance metadata / env, not SSH pushes:
+resource's startup-script (cluster/startup.py) execs this module on every
+worker VM with the cluster identity in env:
 
   DLCFN_CLUSTER          cluster name (required)
+  DLCFN_ROLE             coordinator | worker (default: coordinator iff
+                         DLCFN_WORKER_INDEX == 0)
   DLCFN_WORKER_INDEX     this VM's index in the slice (0 = coordinator)
-  DLCFN_BROKER           host:port of the rendezvous broker
+  DLCFN_BROKER           host:port of the rendezvous broker (required —
+                         without it the agent has no control plane)
   DLCFN_GROUPS           comma-separated worker-group names
   DLCFN_STORAGE_MOUNT    shared storage mount point
   DLCFN_BOOTSTRAP_BUDGET_S  wallclock budget (default 2700, the
                             reference's 3300-600; dl_cfn_setup_v2.py:411-415)
+  DLCFN_POLL_INTERVAL_S  poll cadence (default 30, dl_cfn_setup_v2.py:36)
+  DLCFN_MY_IP            coordinator address override; unset = resolve from
+                         the harvested group state (worker 0's instance IP)
+  DLCFN_ROOT             contract publication dir (default /opt/deeplearning)
 
-Worker 0 runs the coordinator role (waits for group-success, harvests IPs,
-broadcasts the contract, signals ready); everyone else waits for the
-broadcast.  Both end by writing the cluster contract locally, after which
-the training job can `source env.sh` and `jax.distributed.initialize`.
+The agent runs against :class:`BrokerAgentBackend`: group snapshots,
+signals, and queues all come from the broker — a VM needs no cloud
+credentials, mirroring how the reference's workers needed only SQS while
+the master alone called EC2/ASG (dl_cfn_setup_v2.py:170-208 vs :210-281);
+here even the coordinator's "describe" is served by controller-published
+snapshots.  Worker 0 runs the coordinator role (waits for group-success,
+reads harvested IPs, broadcasts the contract, signals ready); everyone else
+waits for the broadcast.  Both end by writing the cluster contract locally,
+after which the training job can `source env.sh` and
+`jax.distributed.initialize`.
 """
 
 from __future__ import annotations
@@ -23,23 +36,18 @@ from __future__ import annotations
 import os
 import sys
 
-from deeplearning_cfn_tpu.cluster.bootstrap import BootstrapAgent, BootstrapError
-from deeplearning_cfn_tpu.cluster.broker_client import BrokerQueue
+from deeplearning_cfn_tpu.cluster.bootstrap import (
+    BootstrapAgent,
+    BootstrapError,
+    cluster_ready_resource,
+)
+from deeplearning_cfn_tpu.cluster.broker_backend import BrokerAgentBackend
+from deeplearning_cfn_tpu.cluster.broker_client import BrokerError
+from deeplearning_cfn_tpu.provision.backend import ResourceSignal
 from deeplearning_cfn_tpu.utils.logging import get_logger
 from deeplearning_cfn_tpu.utils.timeouts import BudgetExhausted, TimeoutBudget
 
 log = get_logger("dlcfn.agent")
-
-
-def _my_ip() -> str:
-    import socket
-
-    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-    try:
-        s.connect(("10.255.255.255", 1))
-        return s.getsockname()[0]
-    finally:
-        s.close()
 
 
 def main() -> int:
@@ -47,41 +55,86 @@ def main() -> int:
     if not cluster:
         log.error("DLCFN_CLUSTER not set; refusing to bootstrap")
         return 2
+    broker = os.environ.get("DLCFN_BROKER")
+    if not broker or ":" not in broker:
+        log.error("DLCFN_BROKER not set (need host:port); refusing to bootstrap")
+        return 2
     index = int(os.environ.get("DLCFN_WORKER_INDEX", "0"))
-    broker = os.environ.get("DLCFN_BROKER", "127.0.0.1:8477")
+    role = os.environ.get("DLCFN_ROLE") or ("coordinator" if index == 0 else "worker")
     host, port = broker.rsplit(":", 1)
     groups = os.environ.get("DLCFN_GROUPS", f"{cluster}-workers").split(",")
     budget_s = float(os.environ.get("DLCFN_BOOTSTRAP_BUDGET_S", "2700"))
+    poll_s = float(os.environ.get("DLCFN_POLL_INTERVAL_S", "30"))
 
-    # The on-VM agent has no cloud-API backend: instance harvesting happens
-    # on the controller side; the agent needs only the two queues.  A
-    # null backend satisfies the coordinator's signal call by writing a
-    # local marker the controller's poll picks up via the broker.
-    from deeplearning_cfn_tpu.provision.local import LocalBackend
-
-    backend = LocalBackend()
+    budget = TimeoutBudget(budget_s)
+    # The broker (on the controller or coordinator host) may come up after
+    # this VM boots; retry within the bootstrap budget instead of dying on
+    # the first refused connection — the same discipline the reference
+    # applied to IAM-credential availability (check_instance_role_availability,
+    # dl_cfn_setup_v2.py:359-386).
+    backend = None
+    while True:
+        try:
+            backend = BrokerAgentBackend(host, int(port))
+            coordinator_queue = backend.get_queue(f"{cluster}-coordinator-queue")
+            worker_queue = backend.get_queue(f"{cluster}-worker-queue")
+            break
+        except OSError as e:
+            if backend is not None:
+                backend.close()
+                backend = None
+            log.info("broker at %s not reachable yet (%s); retrying", broker, e)
+            try:
+                budget.sleep(poll_s, "broker-connect")
+            except BudgetExhausted:
+                log.error("broker at %s unreachable within budget", broker)
+                return 1
 
     agent = BootstrapAgent(
         backend=backend,
         cluster_name=cluster,
-        coordinator_queue=BrokerQueue(f"{cluster}-coordinator-queue", host, int(port)),
-        worker_queue=BrokerQueue(f"{cluster}-worker-queue", host, int(port)),
+        coordinator_queue=coordinator_queue,
+        worker_queue=worker_queue,
         group_names=groups,
-        budget=TimeoutBudget(budget_s),
+        budget=budget,
+        poll_interval_s=poll_s,
         storage_mount=os.environ.get("DLCFN_STORAGE_MOUNT", "/mnt/dlcfn"),
+        group_signal_resources={g: f"group:{g}" for g in groups},
     )
     try:
-        if index == 0 and os.environ.get("DLCFN_ROLE") == "coordinator":
-            contract = agent.run_coordinator(_my_ip())
+        if role == "coordinator":
+            contract = agent.run_coordinator(os.environ.get("DLCFN_MY_IP"))
         else:
             contract = agent.run_worker()
+            # Positive acknowledgment: the controller counts these so a
+            # worker that silently died cannot be declared part of a ready
+            # cluster.  (The reference never verified workers — only the
+            # master signaled; StackSetup.md:107-108 documents the
+            # resulting stale-metadata trap.  This closes it.)
+            backend.get_queue(f"{cluster}-ready-queue").send(
+                {"event": "worker-ready", "index": index, "cluster": cluster}
+            )
     except (BootstrapError, BudgetExhausted) as e:
         log.error("bootstrap failed: %s", e)
+        if role == "coordinator":
+            # Fail the WaitCondition NOW so the controller rolls back within
+            # one poll tick instead of burning the full cluster_ready budget
+            # — the exit-1-drives-rollback semantics of the reference's
+            # master (dl_cfn_setup_v2.py:426-428, deeplearning.template:769-780).
+            try:
+                backend.signal_resource(
+                    cluster_ready_resource(cluster), ResourceSignal.FAILURE
+                )
+            except (OSError, BrokerError):
+                log.error("could not signal FAILURE to broker")
         return 1
+    finally:
+        backend.close()
     log.info(
-        "bootstrap complete: %d workers, I am process %d",
+        "bootstrap complete: %d workers, I am process %d (%s)",
         contract.workers_count,
         index,
+        role,
     )
     return 0
 

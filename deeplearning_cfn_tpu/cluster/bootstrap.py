@@ -184,10 +184,32 @@ class BootstrapAgent:
     def _publish_contract(self, contract: ClusterContract) -> None:
         contract.write(self.contract_root)
 
-    def run_coordinator(self, my_ip: str) -> ClusterContract:
+    def run_coordinator(self, my_ip: str | None = None) -> ClusterContract:
+        """Run the master role.  ``my_ip=None`` resolves the coordinator's
+        address from the harvested group state: worker 0 = the lowest-index
+        instance of the first group (the master-is-also-worker-#1 rule,
+        dl_cfn_setup_v2.py:330-342) — on a real slice that IS this VM, and
+        it is the address every peer will dial, which matters more than
+        what a local socket probe reports."""
         self.wait_for_credentials()
         results = self.wait_for_group_success()
         ips_by_group = self.wait_until_instances_active()
+        if my_ip is None:
+            group0 = self.backend.describe_group(self.group_names[0])
+            me = min(
+                (
+                    i
+                    for i in group0.healthy_instances
+                    if i.state is InstanceState.RUNNING and i.private_ip
+                ),
+                key=lambda i: i.index,
+                default=None,
+            )
+            if me is None or me.private_ip is None:
+                raise BootstrapError(
+                    "contract", "cannot resolve coordinator IP from group state"
+                )
+            my_ip = me.private_ip
         all_ips = [ip for name in self.group_names for ip in ips_by_group[name]]
         degraded = any(r.degraded for r in results.values())
         chips = max(

@@ -105,6 +105,25 @@ class BrokerConnection:
         if self._read_line() != "OK":
             raise BrokerError("PURGE failed")
 
+    # --- shared KV (signals + group-state snapshots) ---------------------
+    def set(self, key: str, value: bytes) -> None:
+        self.sock.sendall(f"SET {key} {len(value)}\n".encode() + value)
+        if self._read_line() != "OK":
+            raise BrokerError("SET failed")
+
+    def get(self, key: str) -> bytes | None:
+        self.sock.sendall(f"GET {key}\n".encode())
+        resp = self._read_line()
+        if resp == "NONE":
+            return None
+        if not resp.startswith("VAL "):
+            raise BrokerError(f"GET failed: {resp}")
+        return self._read_exact(int(resp[4:]))
+
+    def unset(self, key: str) -> bool:
+        self.sock.sendall(f"UNSET {key}\n".encode())
+        return self._read_line() == "OK"
+
 
 class BrokerQueue(RendezvousQueue):
     """RendezvousQueue over the native broker."""

@@ -26,6 +26,7 @@ from __future__ import annotations
 import shlex
 
 from deeplearning_cfn_tpu.config.schema import ClusterSpec
+from deeplearning_cfn_tpu.provision.provisioner import worker_group_name
 
 # Marker file guarding one-time shared-storage data placement — the
 # data.txt trick of mask-rcnn-cfn.yaml:784-789 (cfn-init `test:` guards).
@@ -146,9 +147,40 @@ def _setup_steps(spec: ClusterSpec) -> list[str]:
 
 
 def _agent_step(spec: ClusterSpec) -> list[str]:
-    del spec
+    # deeplearning-config analog: run the discovery agent with the full
+    # cluster identity in env — the AWS_DL_* injection of
+    # deeplearning.template:546-564.  Worker index comes from TPU VM
+    # metadata (every worker of a slice learns its rank from
+    # `agent-worker-number`); the broker address is stamped into instance
+    # attributes by the controller at create time.  Env vars already set
+    # (e.g. by a test harness or a custom image) win over metadata.
+    md = (
+        "curl -sf -H 'Metadata-Flavor: Google' "
+        "http://metadata.google.internal/computeMetadata/v1/instance/"
+    )
     return [
-        # deeplearning-config analog: run the discovery agent with the
-        # cluster identity in env (deeplearning.template:546-564).
+        # Retry the metadata fetch, then REFUSE to boot rather than guess:
+        # a worker that defaulted to index 0 would run a second coordinator
+        # and consume the single group-setup message the real coordinator
+        # is waiting for (wait_for_group_success deletes what it reads).
+        'for _i in 1 2 3 4 5; do '
+        f'DLCFN_WORKER_INDEX="${{DLCFN_WORKER_INDEX:-$({md}attributes/agent-worker-number || true)}}"; '
+        '[ -n "$DLCFN_WORKER_INDEX" ] && break; sleep 2; done',
+        'if [ -z "$DLCFN_WORKER_INDEX" ]; then '
+        "echo 'ERROR: worker index unavailable (metadata + env)'; exit 1; fi",
+        'for _i in 1 2 3 4 5; do '
+        f'DLCFN_BROKER="${{DLCFN_BROKER:-$({md}attributes/dlcfn-broker || true)}}"; '
+        '[ -n "$DLCFN_BROKER" ] && break; sleep 2; done',
+        'if [ -z "$DLCFN_BROKER" ]; then '
+        "echo 'ERROR: broker address unavailable (metadata + env)'; exit 1; fi",
+        'if [ "$DLCFN_WORKER_INDEX" = "0" ]; then '
+        'DLCFN_ROLE="${DLCFN_ROLE:-coordinator}"; '
+        'else DLCFN_ROLE="${DLCFN_ROLE:-worker}"; fi',
+        f'DLCFN_GROUPS="${{DLCFN_GROUPS:-{shlex.quote(worker_group_name(spec.name))}}}"',
+        f'DLCFN_STORAGE_MOUNT="${{DLCFN_STORAGE_MOUNT:-{shlex.quote(spec.storage.mount_point)}}}"',
+        f'DLCFN_BOOTSTRAP_BUDGET_S="${{DLCFN_BOOTSTRAP_BUDGET_S:-{spec.timeouts.bootstrap_budget_s:.0f}}}"',
+        f'DLCFN_POLL_INTERVAL_S="${{DLCFN_POLL_INTERVAL_S:-{spec.timeouts.poll_interval_s:g}}}"',
+        "export DLCFN_WORKER_INDEX DLCFN_BROKER DLCFN_ROLE DLCFN_GROUPS "
+        "DLCFN_STORAGE_MOUNT DLCFN_BOOTSTRAP_BUDGET_S DLCFN_POLL_INTERVAL_S",
         "exec python3 -m deeplearning_cfn_tpu.cluster.agent_main",
     ]

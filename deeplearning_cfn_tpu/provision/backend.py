@@ -134,3 +134,9 @@ class Backend:
 
     def get_resource_signal(self, resource: str) -> ResourceSignal | None:
         raise NotImplementedError
+
+    def clear_resource_signal(self, resource: str) -> None:
+        """Remove a signal so a later provisioning generation of the same
+        cluster name starts clean (recover() reuses names; CloudFormation
+        got this for free from per-stack WaitCondition handles)."""
+        raise NotImplementedError

@@ -150,6 +150,19 @@ class GCPBackend(Backend):
                                     # (deeplearning.template:490-516).
                                     "startup-script": self.startup_script
                                     or "python -m deeplearning_cfn_tpu.cluster.agent_main",
+                                    # Rendezvous address the startup script
+                                    # reads back (attributes/dlcfn-broker);
+                                    # without it agents have no control
+                                    # plane and refuse to bootstrap.
+                                    **(
+                                        {
+                                            "dlcfn-broker": (
+                                                f"{self.broker_host}:{self.broker_port}"
+                                            )
+                                        }
+                                        if self.broker_host
+                                        else {}
+                                    ),
                                 },
                             },
                         }
@@ -364,6 +377,15 @@ class GCPBackend(Backend):
 
     def get_resource_signal(self, resource: str) -> ResourceSignal | None:
         return self._signals.get(resource)
+
+    def clear_resource_signal(self, resource: str) -> None:
+        self._signals.pop(resource, None)
+        try:
+            self.transport(
+                "DELETE", f"b/dlcfn-signals/o/{resource.replace(':', '_')}", None
+            )
+        except KeyError:
+            pass  # marker never written
 
 
 class FakeGCPTransport:

@@ -229,3 +229,6 @@ class LocalBackend(Backend):
 
     def get_resource_signal(self, resource: str) -> ResourceSignal | None:
         return self._signals.get(resource)
+
+    def clear_resource_signal(self, resource: str) -> None:
+        self._signals.pop(resource, None)

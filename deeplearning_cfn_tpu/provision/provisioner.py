@@ -24,6 +24,7 @@ from __future__ import annotations
 import json
 from dataclasses import dataclass
 from pathlib import Path
+from typing import Callable
 
 from deeplearning_cfn_tpu.cluster.bootstrap import (
     BootstrapAgent,
@@ -80,12 +81,36 @@ class ProvisionFailure(RuntimeError):
 
 
 class Provisioner:
-    def __init__(self, backend: Backend, spec: ClusterSpec, contract_root: Path | None = None):
+    def __init__(
+        self,
+        backend: Backend,
+        spec: ClusterSpec,
+        contract_root: Path | None = None,
+        remote_agents: bool = False,
+        progress: "Callable[[float, str], None] | None" = None,
+    ):
+        """``remote_agents=True`` is the production topology: bootstrap
+        agents run on the VMs themselves (``agent_main`` processes reached
+        via the broker) and this process only publishes cloud state and
+        waits for the coordinator's ready signal — the CloudFormation
+        engine's role.  ``False`` runs the agents inline against the
+        backend (the fake-cloud simulation used by unit tests).
+
+        ``progress(elapsed_s, status)`` is called once per poll tick during
+        any slow wait — the stack drivers' poll-every-30s-printing-elapsed
+        behavior (mask-rcnn-stack.sh:84-92)."""
         self.backend = backend
         self.spec = spec.validate()
         self.contract_root = contract_root
+        self.remote_agents = remote_agents
+        self.progress = progress
         self._storage: StorageHandle | None = None
         self._controller = None
+        if remote_agents and not hasattr(backend, "publish_group_state"):
+            raise ValueError(
+                "remote_agents requires a broker-connected backend "
+                "(wrap it in BrokerRendezvousBackend)"
+            )
 
     # -- resource names ---------------------------------------------------
     @property
@@ -100,11 +125,28 @@ class Provisioner:
     def worker_queue_name(self) -> str:
         return f"{self.spec.name}-worker-queue"
 
+    @property
+    def ready_queue_name(self) -> str:
+        return f"{self.spec.name}-ready-queue"
+
     # -- create -----------------------------------------------------------
     def provision(self) -> ProvisionResult:
         spec = self.spec
         pool = spec.pool
 
+        if self.remote_agents:
+            # A shared broker outlives cluster generations; scrub any
+            # signals/broadcasts a previous provision of this name left
+            # behind before agents can read them.
+            self.backend.reset_cluster_state(
+                spec.name,
+                [self.group_name],
+                [
+                    self.coordinator_queue_name,
+                    self.worker_queue_name,
+                    self.ready_queue_name,
+                ],
+            )
         coord_q = self.backend.create_queue(self.coordinator_queue_name)
         worker_q = self.backend.create_queue(self.worker_queue_name)
 
@@ -149,7 +191,10 @@ class Provisioner:
             chips_per_worker=pool.chips_per_worker,
         )
 
-        contract = self._run_bootstrap(coord_q, worker_q)
+        if self.remote_agents:
+            contract = self._await_remote_bootstrap(worker_q)
+        else:
+            contract = self._run_bootstrap(coord_q, worker_q)
         result = ProvisionResult(
             spec=spec,
             contract=contract,
@@ -235,6 +280,107 @@ class Provisioner:
             )
             worker_agent.run_worker()
         return contract
+
+    def _await_remote_bootstrap(self, worker_q) -> ClusterContract:
+        """The CloudFormation-engine side of a real deployment: agents run
+        on the VMs; this process publishes cloud state for them and blocks
+        on the cluster-ready signal (the WaitCondition,
+        deeplearning.template:769-780).
+
+        Each poll tick re-publishes the group snapshot so agents see
+        instance-state transitions (the describe-loop the reference's
+        master ran against EC2 itself, dl_cfn_setup_v2.py:210-281 — here
+        run controller-side because only the controller has credentials).
+        On SUCCESS the contract is read from the coordinator's worker-setup
+        broadcast, which visibility-0/no-delete semantics leave in place
+        for late consumers (dl_cfn_setup_v2.py:180-190)."""
+        spec = self.spec
+        budget = TimeoutBudget(spec.timeouts.cluster_ready_s)
+        resource = cluster_ready_resource(spec.name)
+        group_resource = f"group:{self.group_name}"
+        phase = "remote-bootstrap"
+        while True:
+            group = self.backend.publish_group_state(self.group_name)
+            signal = self.backend.get_resource_signal(resource)
+            if signal is ResourceSignal.SUCCESS:
+                break
+            if signal is ResourceSignal.FAILURE:
+                raise ProvisionFailure(
+                    f"cluster {spec.name!r} signaled FAILURE during bootstrap"
+                )
+            # Fail fast on a below-minimum group verdict: if no coordinator
+            # VM ever booted, nobody translates the group FAILURE into a
+            # cluster-ready FAILURE — the controller must read the verdict
+            # it already rendered instead of burning the whole budget.
+            if (
+                self.backend.get_resource_signal(group_resource)
+                is ResourceSignal.FAILURE
+            ):
+                self.backend.signal_resource(resource, ResourceSignal.FAILURE)
+                raise ProvisionFailure(
+                    f"group {self.group_name!r} failed to reach minimum capacity"
+                )
+            if self.progress is not None:
+                running = sum(
+                    1 for i in group.healthy_instances if i.private_ip
+                )
+                self.progress(
+                    budget.elapsed_s, f"{running}/{group.desired} workers up"
+                )
+            try:
+                budget.sleep(spec.timeouts.poll_interval_s, phase)
+            except BudgetExhausted as e:
+                self.backend.signal_resource(resource, ResourceSignal.FAILURE)
+                raise ProvisionFailure(
+                    f"cluster {spec.name!r} did not become ready within "
+                    f"{spec.timeouts.cluster_ready_s:.0f}s"
+                ) from e
+        # Non-destructive read of the broadcast (late consumers still see it).
+        contract: ClusterContract | None = None
+        for msg in worker_q.receive(max_messages=10, visibility_timeout_s=0.0):
+            if msg.body.get("event") == "worker-setup":
+                contract = ClusterContract.from_message(msg.body)
+                break
+        if contract is None:
+            raise ProvisionFailure(
+                "cluster signaled ready but no worker-setup broadcast found"
+            )
+        self._await_worker_acks(contract, budget)
+        contract.write(self.contract_root)
+        return contract
+
+    def _await_worker_acks(
+        self, contract: ClusterContract, budget: TimeoutBudget
+    ) -> None:
+        """Require a positive worker-ready acknowledgment from every
+        non-coordinator worker before declaring the cluster usable.
+
+        The coordinator's SUCCESS only proves instances were RUNNING; a
+        worker process that died before publishing its contract would
+        otherwise surface as a hang at jax.distributed.initialize.  (The
+        reference shipped exactly that trap — only the master signaled the
+        WaitCondition; worker health was asserted by ASG instance state
+        alone.)"""
+        expected = contract.workers_count - 1
+        if expected <= 0:
+            return
+        ready_q = self.backend.get_queue(self.ready_queue_name)
+        seen: set[int] = set()
+        phase = "worker-acks"
+        while len(seen) < expected:
+            for msg in ready_q.receive(max_messages=10, visibility_timeout_s=60.0):
+                if msg.body.get("event") == "worker-ready":
+                    seen.add(int(msg.body.get("index", -1)))
+                ready_q.delete(msg.receipt)
+            if len(seen) >= expected:
+                return
+            try:
+                budget.sleep(self.spec.timeouts.poll_interval_s, phase)
+            except BudgetExhausted as e:
+                raise ProvisionFailure(
+                    f"only {len(seen)}/{expected} workers acknowledged "
+                    "readiness within budget"
+                ) from e
 
     # -- WaitCondition ----------------------------------------------------
     def wait_until_ready(self) -> None:

@@ -42,6 +42,10 @@ class TimeoutBudget:
     def remaining_s(self) -> float:
         return self.total_s - (self.clock.now() - self._start)
 
+    @property
+    def elapsed_s(self) -> float:
+        return self.clock.now() - self._start
+
     def check(self, phase: str) -> None:
         if self.remaining_s <= 0:
             raise BudgetExhausted(phase, self.total_s)

@@ -23,6 +23,12 @@
 //   DEPTH <queue>\n                       -> OK <n>\n
 //   PURGE <queue>\n                       -> OK\n
 //   PING\n                                -> PONG\n
+//   SET <key> <len>\n<payload>            -> OK\n        (shared KV: signals
+//   GET <key>\n                           -> VAL <len>\n<payload> | NONE\n
+//   UNSET <key>\n                         -> OK\n | MISS\n
+//                                            + group-state snapshots — the
+//                                            WaitCondition/describe analogs
+//                                            agents read on real VMs)
 //
 // Build: make (g++ -O2 -std=c++17 -pthread).  Run: dlcfn-broker <port>.
 
@@ -64,6 +70,7 @@ struct Queue {
 
 std::mutex g_mu;
 std::map<std::string, Queue> g_queues;
+std::map<std::string, std::string> g_kv;
 std::atomic<uint64_t> g_seq{0};
 std::atomic<uint64_t> g_id{0};
 
@@ -175,6 +182,24 @@ void op_purge(const std::string& qname) {
   g_queues[qname].messages.clear();
 }
 
+void op_set(const std::string& key, std::string value) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  g_kv[key] = std::move(value);
+}
+
+bool op_get(const std::string& key, std::string& value) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  auto it = g_kv.find(key);
+  if (it == g_kv.end()) return false;
+  value = it->second;
+  return true;
+}
+
+bool op_unset(const std::string& key) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  return g_kv.erase(key) > 0;
+}
+
 // --- per-connection loop -------------------------------------------------
 
 void serve(int fd) {
@@ -219,6 +244,28 @@ void serve(int fd) {
       ss >> qname;
       op_purge(qname);
       if (!write_all(fd, "OK\n")) break;
+    } else if (cmd == "SET") {
+      std::string key;
+      size_t len = 0;
+      ss >> key >> len;
+      std::string value;
+      if (key.empty() || len > (64u << 20) || !read_exact(fd, value, len)) break;
+      op_set(key, std::move(value));
+      if (!write_all(fd, "OK\n")) break;
+    } else if (cmd == "UNSET") {
+      std::string key;
+      ss >> key;
+      if (!write_all(fd, op_unset(key) ? "OK\n" : "MISS\n")) break;
+    } else if (cmd == "GET") {
+      std::string key;
+      ss >> key;
+      std::string value;
+      if (op_get(key, value)) {
+        if (!write_all(fd, "VAL " + std::to_string(value.size()) + "\n" + value))
+          break;
+      } else {
+        if (!write_all(fd, "NONE\n")) break;
+      }
     } else {
       if (!write_all(fd, "ERR unknown command\n")) break;
     }

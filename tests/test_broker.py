@@ -99,3 +99,81 @@ def test_large_payload(broker):
     msgs = q.receive(max_messages=1, visibility_timeout_s=60)
     assert msgs[0].body == big
     q.delete(msgs[0].receipt)
+
+
+def test_kv_set_get(broker):
+    """The shared-KV surface carrying signals + group-state snapshots."""
+    from deeplearning_cfn_tpu.cluster.broker_client import BrokerConnection
+
+    conn = BrokerConnection("127.0.0.1", broker.port)
+    assert conn.get("signal:nope") is None
+    conn.set("signal:cluster-ready:t", b"SUCCESS")
+    assert conn.get("signal:cluster-ready:t") == b"SUCCESS"
+    conn.set("signal:cluster-ready:t", b"FAILURE")  # overwrite wins
+    assert conn.get("signal:cluster-ready:t") == b"FAILURE"
+    payload = ("{" + '"k":"' + "y" * 100_000 + '"}').encode()
+    conn.set("group-state:big", payload)
+    assert conn.get("group-state:big") == payload
+    conn.close()
+
+
+def test_agent_backend_group_roundtrip(broker):
+    """WorkerGroup snapshots survive the publish/read path agents use."""
+    from deeplearning_cfn_tpu.cluster.broker_backend import (
+        BrokerAgentBackend,
+        GROUP_STATE_KEY_FMT,
+        serialize_group,
+    )
+    from deeplearning_cfn_tpu.cluster.broker_client import BrokerConnection
+    from deeplearning_cfn_tpu.provision.backend import (
+        Instance,
+        InstanceState,
+        ResourceSignal,
+        WorkerGroup,
+    )
+
+    group = WorkerGroup(
+        name="rt-workers", desired=2, minimum=1, chips_per_worker=4,
+        replace_unhealthy_suspended=True,
+        instances=[
+            Instance("i-1", "rt-workers", 0, InstanceState.RUNNING, "10.0.0.2", True, 4),
+            Instance("i-2", "rt-workers", 1, InstanceState.PENDING, None, True, 4),
+        ],
+    )
+    conn = BrokerConnection("127.0.0.1", broker.port)
+    conn.set(GROUP_STATE_KEY_FMT.format(name="rt-workers"), serialize_group(group))
+    conn.close()
+
+    agent = BrokerAgentBackend("127.0.0.1", broker.port)
+    seen = agent.describe_group("rt-workers")
+    assert seen == group
+    # Unpublished group -> unsatisfiable placeholder, not a crash.
+    placeholder = agent.describe_group("ghost")
+    assert placeholder.instances == [] and placeholder.desired == 1
+    agent.signal_resource("group:rt-workers", ResourceSignal.SUCCESS)
+    assert agent.get_resource_signal("group:rt-workers") is ResourceSignal.SUCCESS
+    agent.close()
+
+
+def test_reset_cluster_state_scrubs_previous_generation(broker):
+    """recover() against a live broker must not read the previous
+    cluster's SUCCESS signal or worker-setup broadcast (stale-state bug)."""
+    from deeplearning_cfn_tpu.cluster.bootstrap import cluster_ready_resource
+    from deeplearning_cfn_tpu.cluster.broker_backend import BrokerRendezvousBackend
+    from deeplearning_cfn_tpu.provision.backend import ResourceSignal
+    from deeplearning_cfn_tpu.provision.local import LocalBackend
+
+    be = BrokerRendezvousBackend(LocalBackend(), "127.0.0.1", broker.port)
+    ready = cluster_ready_resource("gen")
+    be.signal_resource(ready, ResourceSignal.SUCCESS)
+    be.signal_resource("group:gen-workers", ResourceSignal.FAILURE)
+    be.get_queue("gen-worker-queue").send({"event": "worker-setup", "stale": True})
+
+    be.reset_cluster_state("gen", ["gen-workers"], ["gen-worker-queue"])
+
+    # Broker side is scrubbed (inner LocalBackend memory is irrelevant to
+    # agents; a fresh controller process starts with an empty inner store).
+    fresh = BrokerRendezvousBackend(LocalBackend(), "127.0.0.1", broker.port)
+    assert fresh.get_resource_signal(ready) is None
+    assert fresh.get_resource_signal("group:gen-workers") is None
+    assert fresh.get_queue("gen-worker-queue").receive(visibility_timeout_s=0.0) == []
