@@ -61,6 +61,11 @@ def main() -> None:
     y = jax.device_put(jnp.asarray(y), trainer.batch_sharding)
 
     state = trainer.init(jax.random.key(0), x)
+    # Cost analysis before any donated execution: flops per compiled step
+    # is the MFU numerator.
+    stats = trainer.compile_stats(state, x, y)
+    flops_per_step = stats.get("flops_per_step")
+
     step = trainer.step_fn
     for _ in range(WARMUP_STEPS):
         state, metrics = step(state, x, y)
@@ -77,6 +82,13 @@ def main() -> None:
 
     images_per_sec = batch * MEASURE_STEPS / dt
     per_chip = images_per_sec / n_chips
+
+    from deeplearning_cfn_tpu.train.metrics import peak_flops_per_chip
+
+    peak = peak_flops_per_chip(devices[0])
+    mfu = None
+    if peak and flops_per_step:
+        mfu = flops_per_step * MEASURE_STEPS / dt / (n_chips * peak)
     print(
         json.dumps(
             {
@@ -84,6 +96,10 @@ def main() -> None:
                 "value": round(per_chip, 2),
                 "unit": "images/sec/chip",
                 "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC_PER_DEVICE, 3),
+                "mfu": round(mfu, 4) if mfu is not None else None,
+                "flops_per_step": flops_per_step,
+                "device_kind": str(getattr(devices[0], "device_kind", "unknown")),
+                "n_chips": n_chips,
             }
         )
     )

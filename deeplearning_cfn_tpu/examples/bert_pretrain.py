@@ -19,6 +19,9 @@ from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
 
 
 def main(argv: list[str] | None = None) -> dict:
+    from deeplearning_cfn_tpu.examples.common import first_step_clock
+
+    t_main = first_step_clock()
     p = base_parser(__doc__)
     p.add_argument("--seq_len", type=int, default=128)
     p.add_argument("--tiny", action="store_true", help="tiny config for smokes")
@@ -59,7 +62,11 @@ def main(argv: list[str] | None = None) -> dict:
     if ckpt:
         ckpt.save(int(state.step), state)
         ckpt.close()
-    return {"final_loss": losses[-1], "steps": len(losses)}
+    return {
+        "final_loss": losses[-1],
+        "steps": len(losses),
+        "first_step_s": first_step_clock(trainer, t_main),
+    }
 
 
 if __name__ == "__main__":

@@ -34,6 +34,9 @@ from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
 
 
 def main(argv: list[str] | None = None) -> dict:
+    from deeplearning_cfn_tpu.examples.common import first_step_clock
+
+    t_main = first_step_clock()
     p = base_parser(__doc__)
     p.add_argument("--model", choices=sorted(CONFIGS), default="vgg11")
     p.add_argument("--bf16", action=argparse.BooleanOptionalAction, default=True)
@@ -108,6 +111,7 @@ def main(argv: list[str] | None = None) -> dict:
         "final_accuracy": last_accuracy["value"],
         "steps": len(losses),
         "history": logger.history,
+        "first_step_s": first_step_clock(trainer, t_main),
     }
     if args.eval_steps:
         import copy
@@ -119,11 +123,14 @@ def main(argv: list[str] | None = None) -> dict:
             eval_batches = image_batches(eval_args, (32, 32, 3), ds, eval_mode=True)
             split = "heldout"
         elif args.data_dir:
-            # No separate split staged: an unshuffled pass over the
-            # TRAINING records — labeled as such so it is never mistaken
+            # eval_mode picks the test/val split when the converter staged
+            # one (genuinely held out); otherwise it is an unshuffled pass
+            # over the TRAINING records — labeled so it is never mistaken
             # for held-out accuracy.
+            from deeplearning_cfn_tpu.examples.common import has_heldout_split
+
             eval_batches = image_batches(args, (32, 32, 3), ds, eval_mode=True)
-            split = "train"
+            split = "heldout" if has_heldout_split(args.data_dir) else "train"
         else:
             # Synthetic: same task (template_seed matches the training
             # templates), disjoint sample stream.

@@ -52,12 +52,51 @@ def base_parser(description: str) -> argparse.ArgumentParser:
              "synthetic data",
     )
     p.add_argument(
+        "--augment_flip",
+        action="store_true",
+        help="horizontal-flip augmentation for uint8 image records "
+             "(train batches only)",
+    )
+    p.add_argument(
         "--metrics_dir",
         default=os.environ.get("DLCFN_METRICS_DIR"),
         help="dir for structured per-worker JSONL metrics (typically the "
              "shared storage mount; the per-rank-logs-on-EFS analog)",
     )
     return p
+
+
+def has_heldout_split(data_dir: str | None) -> bool:
+    """Whether --data_dir contains a test/val/heldout record file — i.e.
+    eval_mode batches will be genuinely held out rather than an unshuffled
+    pass over the training records."""
+    if not data_dir:
+        return False
+    from pathlib import Path
+
+    from deeplearning_cfn_tpu.train.data import probe_data_source
+
+    root = probe_data_source(data_dir.split(":"))
+    if root is None:
+        return False
+    return any(
+        p.stem in ("test", "val", "heldout") for p in Path(root).glob("*.dlc")
+    )
+
+
+def first_step_clock(trainer=None, t0: float | None = None):
+    """Two-phase helper for the job half of the template-to-first-step
+    metric.  Call with no args at main() entry to get the start stamp;
+    call again with (trainer, stamp) after fit() for the seconds from main
+    entry to the first completed step — covering arg parsing, loader
+    construction, and trainer.init, not just fit()'s own compile."""
+    import time
+
+    if trainer is None:
+        return time.perf_counter()
+    if trainer.first_step_at is None:
+        return None
+    return trainer.first_step_at - t0
 
 
 def metrics_sink(args, run_name: str):
@@ -89,8 +128,9 @@ def image_batches(args, image_shape, fallback_ds, eval_mode: bool = False):
     from pathlib import Path
 
     from deeplearning_cfn_tpu.train.data import probe_data_source
+    from deeplearning_cfn_tpu.train.datasets import STATS, normalized_batches
     from deeplearning_cfn_tpu.train.native_loader import NativeRecordLoader
-    from deeplearning_cfn_tpu.train.records import RecordSpec
+    from deeplearning_cfn_tpu.train.records import RecordSpec, read_header
 
     root = probe_data_source(args.data_dir.split(":"))
     if root is None:
@@ -98,8 +138,22 @@ def image_batches(args, image_shape, fallback_ds, eval_mode: bool = False):
     paths = sorted(Path(root).glob("*.dlc"))
     if not paths:
         raise SystemExit(f"--data_dir: no .dlc record files under {root}")
+    if eval_mode:
+        # Held-out scoring reads the test/val split when present.
+        evals = [p for p in paths if p.stem in ("test", "val", "heldout")]
+        paths = evals or paths
+    elif len(paths) > 1:
+        trains = [p for p in paths if p.stem not in ("test", "val", "heldout")]
+        paths = trains or paths
     batch = args.global_batch_size or fallback_ds.batch_size
+    # Records may be float32 (synthetic staging) or uint8 (real-dataset
+    # converters, train/datasets.py); the file header disambiguates.
+    record_size, _ = read_header(paths[0])
     spec = RecordSpec.classification(image_shape)
+    u8_spec = RecordSpec.classification(image_shape, "uint8")
+    normalize = False
+    if record_size == u8_spec.record_size != spec.record_size:
+        spec, normalize = u8_spec, True
     multi = jax.process_count() > 1
     loader = NativeRecordLoader(
         paths,
@@ -112,8 +166,40 @@ def image_batches(args, image_shape, fallback_ds, eval_mode: bool = False):
         n_threads=1 if (multi or eval_mode) else 4,
     )
     log.info(
-        "data%s: %d record files under %s (%d records, %d batches/epoch)",
+        "data%s: %d record files under %s (%d records, %d batches/epoch%s)",
         " [eval]" if eval_mode else "", len(paths), root,
         loader.shard_records, loader.batches_per_epoch,
+        ", uint8+normalize" if normalize else "",
     )
-    return loader.batches
+    if not normalize:
+        return loader.batches
+    from deeplearning_cfn_tpu.train.datasets import read_stats_sidecar
+
+    # The converter pins the normalization identity in stats.json; the
+    # shape-based guess is only a fallback for hand-rolled record dirs.
+    stats = read_stats_sidecar(root)
+    if stats is None:
+        channels = int(image_shape[-1])
+        guess = {1: "mnist", 3: "cifar10" if image_shape[0] <= 64 else "imagenet"}.get(
+            channels
+        )
+        if guess is None:
+            raise SystemExit(
+                f"--data_dir: uint8 records with {channels} channels and no "
+                f"stats.json under {root}; rerun `dlcfn convert` (it writes "
+                "the sidecar) or add stats.json with mean/std"
+            )
+        log.warning(
+            "no stats.json under %s; guessing %s normalization from image "
+            "shape %s — convert with `dlcfn convert` to pin it",
+            root, guess, tuple(image_shape),
+        )
+        stats = STATS[guess]
+    flip = bool(getattr(args, "augment_flip", False)) and not eval_mode
+
+    def batches(steps):
+        return normalized_batches(
+            loader.batches(steps), stats.mean, stats.std, flip=flip
+        )
+
+    return batches

@@ -36,7 +36,46 @@ BACKBONES = {
 }
 
 
+def record_batches(args, batch: int, eval_mode: bool = False):
+    """COCO-converted DLC1 detection records (``dlcfn convert --format
+    coco``, train/datasets.py) when --data_dir is set; None = synthetic.
+    Eval mode reads the val/test split unshuffled, single pass."""
+    if not args.data_dir:
+        return None
+    from pathlib import Path
+
+    from deeplearning_cfn_tpu.train.data import probe_data_source
+    from deeplearning_cfn_tpu.train.datasets import detection_batches, detection_spec
+    from deeplearning_cfn_tpu.train.native_loader import NativeRecordLoader
+
+    root = probe_data_source(args.data_dir.split(":"))
+    if root is None:
+        raise SystemExit(f"--data_dir: none of {args.data_dir!r} exists")
+    paths = sorted(Path(root).glob("*.dlc"))
+    if eval_mode:
+        evals = [p for p in paths if p.stem in ("val", "test", "heldout")]
+        paths = evals or paths
+    else:
+        trains = [p for p in paths if p.stem not in ("val", "test", "heldout")]
+        paths = trains or paths
+    if not paths:
+        raise SystemExit(f"--data_dir: no .dlc record files under {root}")
+    spec = detection_spec(args.image_size, args.max_boxes)
+    loader = NativeRecordLoader(
+        paths,
+        spec,
+        batch_size=batch,
+        shuffle=not eval_mode,
+        loop=not eval_mode,
+        n_threads=1 if (eval_mode or jax.process_count() > 1) else 4,
+    )
+    return lambda steps: detection_batches(loader, spec, steps)
+
+
 def main(argv: list[str] | None = None) -> dict:
+    from deeplearning_cfn_tpu.examples.common import first_step_clock
+
+    t_main = first_step_clock()
     p = base_parser(__doc__)
     p.add_argument("--backbone", choices=sorted(BACKBONES), default="resnet50")
     p.add_argument("--image_size", type=int, default=256)
@@ -96,16 +135,22 @@ def main(argv: list[str] | None = None) -> dict:
         max_boxes=args.max_boxes,
         batch_size=batch,
     )
-    sample = next(iter(ds.batches(1)))
+    batches = record_batches(args, batch) or ds.batches
+    sample = next(iter(batches(1)))
     state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
     logger = ThroughputLogger(
         global_batch_size=batch, log_every=args.log_every, name="detection",
         sink=metrics_sink(args, "detection"),
     )
     state, losses = trainer.fit(
-        state, ds.batches(args.steps), steps=args.steps, logger=logger
+        state, batches(args.steps), steps=args.steps, logger=logger
     )
-    result = {"final_loss": losses[-1], "steps": len(losses), "history": logger.history}
+    result = {
+        "final_loss": losses[-1],
+        "steps": len(losses),
+        "history": logger.history,
+        "first_step_s": first_step_clock(trainer, t_main),
+    }
     if args.eval_steps:
         result["eval"] = evaluate_map(
             model, trainer, state, anchors, args, batch, steps=args.eval_steps
@@ -139,13 +184,16 @@ def evaluate_map(model, trainer, state, anchors, args, batch, steps: int) -> dic
             lambda c, b: retinanet.predict(c, b, anchors, max_detections=50)
         )(cls_out, box_out)
 
-    held_out = SyntheticDetectionDataset(
-        image_size=args.image_size, num_classes=args.num_classes,
-        max_boxes=args.max_boxes, batch_size=batch,
-        seed=7_000, template_seed=0,
-    )
+    eval_batches = record_batches(args, batch, eval_mode=True)
+    if eval_batches is None:
+        held_out = SyntheticDetectionDataset(
+            image_size=args.image_size, num_classes=args.num_classes,
+            max_boxes=args.max_boxes, batch_size=batch,
+            seed=7_000, template_seed=0,
+        )
+        eval_batches = held_out.batches
     acc = DetectionAccumulator(num_classes=args.num_classes)
-    for batch_data in held_out.batches(steps):
+    for batch_data in eval_batches(steps):
         x = jax.device_put(batch_data.x, trainer.batch_sharding)
         with jax.set_mesh(trainer.mesh):
             dets = jax.device_get(infer(state.params, state.model_state, x))

@@ -22,6 +22,9 @@ from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
 
 
 def main(argv: list[str] | None = None) -> dict:
+    from deeplearning_cfn_tpu.examples.common import first_step_clock
+
+    t_main = first_step_clock()
     args = base_parser(__doc__).parse_args(argv)
     maybe_init_distributed()
     batch = args.global_batch_size or 64
@@ -44,7 +47,11 @@ def main(argv: list[str] | None = None) -> dict:
     _sink = metrics_sink(args, 'lenet')
     logger = ThroughputLogger(global_batch_size=batch, log_every=args.log_every, name="lenet", sink=_sink)
     state, losses = trainer.fit(state, ds.batches(args.steps), steps=args.steps, logger=logger)
-    return {"final_loss": losses[-1], "steps": len(losses)}
+    return {
+        "final_loss": losses[-1],
+        "steps": len(losses),
+        "first_step_s": first_step_clock(trainer, t_main),
+    }
 
 
 if __name__ == "__main__":

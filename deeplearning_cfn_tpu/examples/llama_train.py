@@ -24,6 +24,9 @@ from deeplearning_cfn_tpu.train.trainer import TrainerConfig
 
 
 def main(argv: list[str] | None = None) -> dict:
+    from deeplearning_cfn_tpu.examples.common import first_step_clock
+
+    t_main = first_step_clock()
     p = base_parser(__doc__)
     p.add_argument("--size", choices=["tiny", "8b"], default="tiny")
     p.add_argument("--seq_len", type=int, default=512)
@@ -99,6 +102,7 @@ def main(argv: list[str] | None = None) -> dict:
         "steps": len(losses),
         "mesh": {"dp": dp, "fsdp": fsdp, "pp": pp, "sp": sp, "tp": tp, "ep": ep},
         "params": llama.param_count(cfg),
+        "first_step_s": first_step_clock(trainer, t_main),
     }
 
 

@@ -31,6 +31,9 @@ DEPTHS = {50: ResNet50, 101: ResNet101, 152: ResNet152}
 
 
 def main(argv: list[str] | None = None) -> dict:
+    from deeplearning_cfn_tpu.examples.common import first_step_clock
+
+    t_main = first_step_clock()
     p = base_parser(__doc__)
     p.add_argument("--depth", type=int, choices=sorted(DEPTHS), default=50)
     p.add_argument("--image_size", type=int, default=224)
@@ -60,7 +63,12 @@ def main(argv: list[str] | None = None) -> dict:
         name=f"resnet{args.depth}", sink=metrics_sink(args, f"resnet{args.depth}"),
     )
     state, losses = trainer.fit(state, batches(args.steps), steps=args.steps, logger=logger)
-    return {"final_loss": losses[-1], "steps": len(losses), "history": logger.history}
+    return {
+        "final_loss": losses[-1],
+        "steps": len(losses),
+        "history": logger.history,
+        "first_step_s": first_step_clock(trainer, t_main),
+    }
 
 
 if __name__ == "__main__":

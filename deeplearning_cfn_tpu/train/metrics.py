@@ -20,6 +20,36 @@ from deeplearning_cfn_tpu.utils.logging import get_logger
 
 log = get_logger("dlcfn.train")
 
+# Peak dense bf16 matmul throughput per chip, by JAX device_kind — the
+# denominator of MFU.  The reference had no utilization readout at all
+# (its closest artifact is examples/sec in the _LoggerHook,
+# cifar10_multi_machine_train.py:38-60); on TPU the honest headline metric
+# is model FLOPs utilization against the MXU peak.
+PEAK_BF16_FLOPS_PER_CHIP: dict[str, float] = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,  # v5p reports "TPU v5"
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops_per_chip(device=None) -> float | None:
+    """Peak bf16 FLOP/s for a JAX device, or None when unknown (CPU/GPU
+    backends used in tests).  Longest-prefix match so 'TPU v5 lite'
+    wins over 'TPU v5'."""
+    d = device if device is not None else jax.devices()[0]
+    kind = str(getattr(d, "device_kind", ""))
+    best: tuple[int, float] | None = None
+    for prefix, flops in PEAK_BF16_FLOPS_PER_CHIP.items():
+        if kind.startswith(prefix) and (best is None or len(prefix) > best[0]):
+            best = (len(prefix), flops)
+    return best[1] if best is not None else None
+
 
 @dataclass
 class JsonlMetricsSink:
@@ -62,36 +92,51 @@ class JsonlMetricsSink:
 
 @dataclass
 class ThroughputLogger:
+    """Per-N-steps throughput/loss logger.  ``loss`` may be a device
+    scalar: it is materialized (forcing a host sync) only on log steps,
+    so callers in async-dispatch loops stay sync-free between logs.
+
+    With ``flops_per_step`` (from ``Trainer.compile_stats``) and
+    ``peak_flops`` (aggregate peak over the chips in use, e.g.
+    ``n_chips * peak_flops_per_chip()``), each record also carries MFU.
+    """
+
     global_batch_size: int
     log_every: int = 10
     name: str = "train"
     sink: JsonlMetricsSink | None = None
+    flops_per_step: float | None = None
+    peak_flops: float | None = None
     _t0: float = field(default_factory=time.perf_counter)
     _last_step: int = 0
     history: list[dict] = field(default_factory=list)
 
-    def step(self, step: int, loss: float) -> None:
+    def step(self, step: int, loss) -> None:
         if step % self.log_every:
             return
         now = time.perf_counter()
         dsteps = step - self._last_step
+        dt = now - self._t0
         examples_per_sec = (
-            self.global_batch_size * dsteps / (now - self._t0) if dsteps else 0.0
+            self.global_batch_size * dsteps / dt if dsteps else 0.0
         )
         record = {
             "step": step,
             "loss": float(loss),
             "examples_per_sec": examples_per_sec,
         }
+        if self.flops_per_step and self.peak_flops and dsteps and dt > 0:
+            record["mfu"] = self.flops_per_step * dsteps / dt / self.peak_flops
         self.history.append(record)
         if self.sink is not None:
             self.sink.write({"event": "train_step", "run": self.name, **record})
         log.info(
-            "%s step=%d loss=%.4f examples/sec=%.1f",
+            "%s step=%d loss=%.4f examples/sec=%.1f%s",
             self.name,
             step,
-            float(loss),
+            record["loss"],
             examples_per_sec,
+            f" mfu={record['mfu']:.3f}" if "mfu" in record else "",
         )
         self._t0 = now
         self._last_step = step

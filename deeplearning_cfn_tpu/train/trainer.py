@@ -153,6 +153,12 @@ class Trainer:
         )
         self._step_fn = None
         self.state_shardings: TrainState | None = None
+        # Set by fit(): wallclock from fit entry to the first completed
+        # step (compile included), and the absolute perf_counter timestamp
+        # of that completion (lets callers measure from their own start,
+        # covering data/loader/init setup that precedes fit).
+        self.first_step_seconds: float | None = None
+        self.first_step_at: float | None = None
 
     # --- loss -----------------------------------------------------------
     def _default_objective(
@@ -387,9 +393,22 @@ class Trainer:
     ) -> tuple[TrainState, list[float]]:
         """``stop_fn(metrics) -> True`` ends training early — the
         time-to-accuracy mode (the reference's only published CIFAR metric
-        is 100-epochs-to-92%-accuracy, README.md:141)."""
+        is 100-epochs-to-92%-accuracy, README.md:141).
+
+        The loop never reads a metric back to the host per step: a
+        per-step ``float(loss)`` would serialize host and device and
+        defeat XLA's async dispatch.  Device scalars are collected and
+        materialized once at the end; the host blocks (and ``stop_fn``
+        runs) only every ``config.log_every`` steps, which both bounds
+        how far dispatch runs ahead of the device and sets the
+        early-stop granularity (set ``log_every=1`` for per-step
+        stopping).
+        """
         losses: list[float] = []
+        pending: list[jax.Array] = []  # device scalars awaiting readback
         step_fn = self.step_fn
+        sync_every = max(1, int(self.config.log_every))
+        t_fit = time.perf_counter()
         # Global step tracked host-side (syncing state.step every iteration
         # would stall the dispatch pipeline); resume-aware so checkpoints
         # after a restore are labeled with the true training step.
@@ -405,14 +424,29 @@ class Trainer:
             with jax.set_mesh(self.mesh):
                 state, metrics = step_fn(state, x, y)
             gstep += 1
-            loss = float(metrics["loss"])
-            losses.append(loss)
+            pending.append(metrics["loss"])
+            if i == 0:
+                # Time-to-first-step (includes compile) — one half of the
+                # driver's template-to-first-step wallclock metric; the
+                # block is one-time and doubles as compile completion.
+                jax.block_until_ready(metrics["loss"])
+                self.first_step_seconds = time.perf_counter() - t_fit
+                self.first_step_at = time.perf_counter()
             if logger:
-                logger.step(gstep, loss)
+                # The logger converts to float only at its own log_every
+                # boundaries — passing the device scalar keeps non-log
+                # steps sync-free.
+                logger.step(gstep, metrics["loss"])
             if checkpointer is not None and checkpointer.should_save(gstep):
                 checkpointer.save(gstep, state)
-            if stop_fn is not None and stop_fn(metrics):
-                break
+            if gstep % sync_every == 0 or i == steps - 1:
+                # The host blocks here anyway, so drain the pending device
+                # scalars — O(log_every) live buffers instead of O(steps).
+                losses.extend(float(v) for v in jax.device_get(pending))
+                pending.clear()
+                if stop_fn is not None and stop_fn(metrics):
+                    break
+        losses.extend(float(v) for v in jax.device_get(pending))
         return state, losses
 
     # --- compile diagnostics ---------------------------------------------
