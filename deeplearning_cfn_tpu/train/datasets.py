@@ -1,0 +1,424 @@
+"""Real-dataset ingestion: standard public formats → DLC1 records.
+
+The reference's data story is "stage the real dataset, then train on it":
+COCO 2017 + an ImageNet-pretrained backbone tarred to S3
+(examples/distributed-tensorflow/prepare-s3-bucket.sh:23-50) and the
+CIFAR-10 walkthrough trained to 92% accuracy (README.md:141).  Round 1
+shipped the DLC1 container, writer, and native loader but no converter
+from any real dataset; this module closes that gap: each ``convert_*``
+reads a dataset in its standard public on-disk layout and writes DLC1
+record files the native loader (train/native_loader.py) consumes.
+
+Supported source formats:
+
+- **CIFAR-10** python pickles (``cifar-10-batches-py/data_batch_*`` +
+  ``test_batch``, the exact layout of cs.toronto.edu's
+  cifar-10-python.tar.gz — what the reference's MXNet walkthrough
+  downloads under the hood).
+- **MNIST** idx files (``train-images-idx3-ubyte[.gz]`` etc.).
+- **ImageFolder** trees (``<root>/<class_name>/*.jpg``) — the torchvision
+  layout ImageNet is distributed in; JPEG decode via PIL, resize +
+  center-crop to a fixed shape (fixed-size records are the TPU-first
+  constraint: static shapes, contiguous batches).
+- **COCO** detection (``instances_*.json`` + an image dir): letterboxed
+  fixed-size images with scaled boxes padded to ``max_boxes`` — the
+  ingestion the Mask R-CNN flagship staged via S3 tars
+  (mask-rcnn-cfn.yaml:790-827).
+
+Images are stored as uint8 (4x smaller files, 4x less host IO than
+float32) and normalized to float on the host at batch time
+(:func:`normalize_images`); dataset mean/std constants live here so
+training and eval stay consistent.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import pickle
+import struct
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from deeplearning_cfn_tpu.train.data import Batch
+from deeplearning_cfn_tpu.train.records import Field, RecordSpec, write_records
+from deeplearning_cfn_tpu.utils.logging import get_logger
+
+log = get_logger("dlcfn.datasets")
+
+# Per-channel statistics (uint8 domain /255) — the standard published
+# values, used by both the converter-side docs and normalize_images.
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+MNIST_MEAN = np.array([0.1307], np.float32)
+MNIST_STD = np.array([0.3081], np.float32)
+
+
+class DatasetFormatError(ValueError):
+    pass
+
+
+def write_stats_sidecar(
+    out_dir: str | Path, dataset: str, mean: np.ndarray, std: np.ndarray
+) -> None:
+    """``stats.json`` next to the records: pins the normalization identity
+    at convert time so loaders never have to guess it from image shape."""
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    (out_dir / "stats.json").write_text(
+        json.dumps(
+            {"dataset": dataset, "mean": mean.tolist(), "std": std.tolist()}
+        )
+    )
+
+
+def read_stats_sidecar(root: str | Path) -> "ImageStats | None":
+    try:
+        payload = json.loads((Path(root) / "stats.json").read_text())
+        return ImageStats(
+            np.asarray(payload["mean"], np.float32),
+            np.asarray(payload["std"], np.float32),
+        )
+    except (FileNotFoundError, json.JSONDecodeError, KeyError, ValueError):
+        return None
+
+
+def normalize_images(
+    x_u8: np.ndarray, mean: np.ndarray, std: np.ndarray
+) -> np.ndarray:
+    """[B, H, W, C] uint8 -> float32, (x/255 - mean)/std per channel."""
+    return ((x_u8.astype(np.float32) / 255.0) - mean) / std
+
+
+def normalized_batches(
+    batches: Iterator[Batch],
+    mean: np.ndarray,
+    std: np.ndarray,
+    flip: bool = False,
+    seed: int = 0,
+) -> Iterator[Batch]:
+    """Wrap a uint8-image batch stream with normalization (+ optional
+    horizontal-flip augmentation, host-side and cheap)."""
+    rng = np.random.default_rng(seed)
+    for b in batches:
+        x = normalize_images(b.x, mean, std)
+        if flip:
+            flips = rng.random(len(x)) < 0.5
+            x[flips] = x[flips, :, ::-1]
+        yield Batch(x=x, y=b.y)
+
+
+# --- CIFAR-10 ----------------------------------------------------------------
+
+CIFAR10_SPEC = RecordSpec.classification((32, 32, 3), "uint8")
+
+
+def _load_cifar_batch(path: Path) -> tuple[np.ndarray, np.ndarray]:
+    with open(path, "rb") as f:
+        d = pickle.load(f, encoding="bytes")
+    data = np.asarray(d[b"data"], np.uint8)
+    labels = np.asarray(d.get(b"labels", d.get(b"fine_labels")), np.int32)
+    if data.ndim != 2 or data.shape[1] != 3072:
+        raise DatasetFormatError(f"{path}: expected [N, 3072] u8, got {data.shape}")
+    # Stored CHW-planar (1024 R, 1024 G, 1024 B per row) -> HWC.
+    images = data.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return np.ascontiguousarray(images), labels
+
+
+def convert_cifar10(src: str | Path, out_dir: str | Path) -> dict:
+    """``cifar-10-batches-py`` -> ``train.dlc`` + ``test.dlc``."""
+    src = Path(src)
+    if (src / "cifar-10-batches-py").is_dir():
+        src = src / "cifar-10-batches-py"
+    train_files = sorted(src.glob("data_batch_*"))
+    if not train_files:
+        raise DatasetFormatError(f"no data_batch_* files under {src}")
+    out_dir = Path(out_dir)
+    counts = {}
+    for split, files in (
+        ("train", train_files),
+        ("test", [src / "test_batch"] if (src / "test_batch").exists() else []),
+    ):
+        if not files:
+            continue
+
+        def gen():
+            for path in files:
+                images, labels = _load_cifar_batch(path)
+                for x, y in zip(images, labels):
+                    yield CIFAR10_SPEC.encode(x=x, y=y)
+
+        counts[split] = write_records(out_dir / f"{split}.dlc", CIFAR10_SPEC, gen())
+        log.info("cifar10 %s: %d records -> %s", split, counts[split], out_dir)
+    write_stats_sidecar(out_dir, "cifar10", CIFAR10_MEAN, CIFAR10_STD)
+    return {"spec": "cifar10", "out_dir": str(out_dir), "records": counts}
+
+
+# --- MNIST (idx) -------------------------------------------------------------
+
+MNIST_SPEC = RecordSpec.classification((28, 28, 1), "uint8")
+
+
+def _open_maybe_gz(path: Path):
+    return gzip.open(path, "rb") if path.suffix == ".gz" else open(path, "rb")
+
+
+def _read_idx(path: Path) -> np.ndarray:
+    with _open_maybe_gz(path) as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dtype_code = (magic >> 8) & 0xFF
+        if dtype_code != 0x08:  # unsigned byte — the only MNIST dtype
+            raise DatasetFormatError(f"{path}: unsupported idx dtype {dtype_code:#x}")
+        dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    if data.size != int(np.prod(dims)):
+        raise DatasetFormatError(f"{path}: payload {data.size} != dims {dims}")
+    return data.reshape(dims)
+
+
+def _find_idx(src: Path, stem: str) -> Path | None:
+    for suffix in ("", ".gz"):
+        p = src / f"{stem}{suffix}"
+        if p.exists():
+            return p
+    return None
+
+
+def convert_mnist(src: str | Path, out_dir: str | Path) -> dict:
+    """idx files (optionally gzipped) -> ``train.dlc`` + ``test.dlc``."""
+    src, out_dir = Path(src), Path(out_dir)
+    counts = {}
+    for split, img_stem, lbl_stem in (
+        ("train", "train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+        ("test", "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    ):
+        img_path, lbl_path = _find_idx(src, img_stem), _find_idx(src, lbl_stem)
+        if img_path is None or lbl_path is None:
+            continue
+        images = _read_idx(img_path)[..., None]  # [N, 28, 28, 1]
+        labels = _read_idx(lbl_path).astype(np.int32)
+        if len(images) != len(labels):
+            raise DatasetFormatError(
+                f"{split}: {len(images)} images != {len(labels)} labels"
+            )
+        counts[split] = write_records(
+            out_dir / f"{split}.dlc",
+            MNIST_SPEC,
+            (MNIST_SPEC.encode(x=x, y=y) for x, y in zip(images, labels)),
+        )
+        log.info("mnist %s: %d records -> %s", split, counts[split], out_dir)
+    if not counts:
+        raise DatasetFormatError(f"no idx files found under {src}")
+    write_stats_sidecar(out_dir, "mnist", MNIST_MEAN, MNIST_STD)
+    return {"spec": "mnist", "out_dir": str(out_dir), "records": counts}
+
+
+# --- ImageFolder (ImageNet layout) ------------------------------------------
+
+
+def _load_image_rgb(path: Path, size: int):
+    """Resize shorter side to ~1.15*size then center-crop to size x size —
+    the standard ImageNet eval transform, baked at ingestion time because
+    DLC1 records are fixed-shape."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        im = im.convert("RGB")
+        w, h = im.size
+        scale = (size * 1.15) / min(w, h)
+        im = im.resize(
+            (max(size, round(w * scale)), max(size, round(h * scale))),
+            Image.BILINEAR,
+        )
+        w, h = im.size
+        left, top = (w - size) // 2, (h - size) // 2
+        im = im.crop((left, top, left + size, top + size))
+        return np.asarray(im, np.uint8)
+
+
+def imagefolder_spec(size: int) -> RecordSpec:
+    return RecordSpec.classification((size, size, 3), "uint8")
+
+
+def convert_imagefolder(
+    src: str | Path,
+    out_dir: str | Path,
+    size: int = 224,
+    split: str = "train",
+    class_names: Sequence[str] | None = None,
+) -> dict:
+    """``<src>/<class>/*.{jpg,jpeg,png}`` -> ``<split>.dlc``.
+
+    ``class_names`` pins the class->index mapping (pass the training
+    split's mapping when converting val so labels agree); default is the
+    sorted subdirectory names, torchvision's convention.
+    """
+    src, out_dir = Path(src), Path(out_dir)
+    classes = list(class_names) if class_names else sorted(
+        p.name for p in src.iterdir() if p.is_dir()
+    )
+    if not classes:
+        raise DatasetFormatError(f"no class subdirectories under {src}")
+    index = {c: i for i, c in enumerate(classes)}
+    spec = imagefolder_spec(size)
+
+    def gen():
+        for cls in classes:
+            for img in sorted((src / cls).iterdir()):
+                if img.suffix.lower() not in (".jpg", ".jpeg", ".png", ".bmp"):
+                    continue
+                yield spec.encode(
+                    x=_load_image_rgb(img, size), y=np.int32(index[cls])
+                )
+
+    n = write_records(out_dir / f"{split}.dlc", spec, gen())
+    (out_dir / "classes.json").write_text(json.dumps(classes))
+    write_stats_sidecar(out_dir, "imagenet", IMAGENET_MEAN, IMAGENET_STD)
+    log.info("imagefolder %s: %d records (%d classes) -> %s",
+             split, n, len(classes), out_dir)
+    return {
+        "spec": f"imagefolder{size}",
+        "out_dir": str(out_dir),
+        "records": {split: n},
+        "classes": len(classes),
+    }
+
+
+# --- COCO detection ----------------------------------------------------------
+
+
+def detection_spec(size: int, max_boxes: int) -> RecordSpec:
+    """Fixed-shape detection record: letterboxed uint8 image + padded
+    ground truth (boxes y1,x1,y2,x2 in resized-image pixels; class -1 =
+    padding) — the shape contract of the RetinaNet trainer
+    (models/retinanet.py fixed-shape matching)."""
+    return RecordSpec(
+        (
+            Field("x", "uint8", (size, size, 3)),
+            Field("boxes", "float32", (max_boxes, 4)),
+            Field("classes", "int32", (max_boxes,)),
+        )
+    )
+
+
+def _letterbox(img: np.ndarray, size: int) -> tuple[np.ndarray, float]:
+    """Scale longest side to ``size``, pad bottom/right; returns (out, scale)."""
+    from PIL import Image
+
+    h, w = img.shape[:2]
+    scale = size / max(h, w)
+    nh, nw = max(1, round(h * scale)), max(1, round(w * scale))
+    im = Image.fromarray(img).resize((nw, nh), Image.BILINEAR)
+    out = np.zeros((size, size, 3), np.uint8)
+    out[:nh, :nw] = np.asarray(im, np.uint8)
+    return out, scale
+
+
+def convert_coco(
+    images_dir: str | Path,
+    annotations: str | Path,
+    out_dir: str | Path,
+    size: int = 512,
+    max_boxes: int = 50,
+    split: str = "train",
+) -> dict:
+    """COCO ``instances_*.json`` + image dir -> ``<split>.dlc``.
+
+    Category ids are remapped to a dense [0, n) contiguous range (COCO's
+    published ids have holes); the mapping is written next to the records
+    as ``categories.json``.
+    """
+    from PIL import Image
+
+    images_dir, out_dir = Path(images_dir), Path(out_dir)
+    ann = json.loads(Path(annotations).read_text())
+    cats = sorted(c["id"] for c in ann.get("categories", []))
+    cat_index = {cid: i for i, cid in enumerate(cats)}
+    by_image: dict[int, list[dict]] = {}
+    for a in ann.get("annotations", []):
+        if a.get("iscrowd"):
+            continue
+        by_image.setdefault(a["image_id"], []).append(a)
+    spec = detection_spec(size, max_boxes)
+
+    skipped = 0
+
+    def gen():
+        nonlocal skipped
+        for info in ann.get("images", []):
+            path = images_dir / info["file_name"]
+            if not path.exists():
+                skipped += 1
+                continue
+            with Image.open(path) as im:
+                img = np.asarray(im.convert("RGB"), np.uint8)
+            out, scale = _letterbox(img, size)
+            boxes = np.zeros((max_boxes, 4), np.float32)
+            classes = np.full((max_boxes,), -1, np.int32)
+            anns = by_image.get(info["id"], [])[:max_boxes]
+            for i, a in enumerate(anns):
+                x0, y0, w, h = a["bbox"]  # COCO xywh, original pixels
+                boxes[i] = (y0 * scale, x0 * scale, (y0 + h) * scale, (x0 + w) * scale)
+                classes[i] = cat_index[a["category_id"]]
+            yield spec.encode(x=out, boxes=boxes, classes=classes)
+
+    n = write_records(out_dir / f"{split}.dlc", spec, gen())
+    (out_dir / "categories.json").write_text(
+        json.dumps({"coco_ids": cats, "num_classes": len(cats)})
+    )
+    if skipped:
+        log.warning("coco %s: %d annotated images missing on disk", split, skipped)
+    log.info("coco %s: %d records (%d classes) -> %s", split, n, len(cats), out_dir)
+    return {
+        "spec": f"coco{size}",
+        "out_dir": str(out_dir),
+        "records": {split: n},
+        "classes": len(cats),
+        "skipped_images": skipped,
+    }
+
+
+def detection_batches(
+    loader, spec: RecordSpec, steps: int | None = None
+) -> Iterator[Batch]:
+    """Decode detection records from a NativeRecordLoader into the
+    trainer's ``Batch(x, y={"boxes", "classes"})`` shape, normalizing
+    images with ImageNet statistics."""
+    i = 0
+    while steps is None or i < steps:
+        raw = loader.next_raw(copy=False)
+        if raw is None:
+            return
+        arrays = spec.decode_batch(raw)
+        yield Batch(
+            x=normalize_images(arrays["x"], IMAGENET_MEAN, IMAGENET_STD),
+            y={"boxes": arrays["boxes"], "classes": arrays["classes"]},
+        )
+        i += 1
+
+
+# --- dispatch ----------------------------------------------------------------
+
+CONVERTERS = {
+    "cifar10": convert_cifar10,
+    "mnist": convert_mnist,
+}
+
+
+@dataclass(frozen=True)
+class ImageStats:
+    mean: np.ndarray
+    std: np.ndarray
+
+
+STATS = {
+    "cifar10": ImageStats(CIFAR10_MEAN, CIFAR10_STD),
+    "mnist": ImageStats(MNIST_MEAN, MNIST_STD),
+    "imagenet": ImageStats(IMAGENET_MEAN, IMAGENET_STD),
+}
