@@ -26,6 +26,7 @@ message.  Consumers dedup by group name, exactly as the master bootstrap did
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Callable
 
 from deeplearning_cfn_tpu.provision.backend import Backend, InstanceState, ResourceSignal
 from deeplearning_cfn_tpu.provision.events import EventKind, LifecycleEvent
@@ -51,6 +52,11 @@ class ElasticityController:
     policies: dict[str, GroupPolicy] = field(default_factory=dict)
     lost_instances: list[str] = field(default_factory=list)
     degraded_groups: set[str] = field(default_factory=set)
+    # Called on every post-provision instance loss (terminate events for a
+    # managed group).  The recovery automation (cluster/recovery.py) hangs
+    # off this seam; the reference had no equivalent — its Lambda only
+    # logged terminations (lambda_function.py:173-199).
+    on_instance_loss: Callable[[GroupPolicy, LifecycleEvent], None] | None = None
 
     def register(self, policy: GroupPolicy) -> None:
         self.policies[policy.name] = policy
@@ -152,3 +158,5 @@ class ElasticityController:
             event.instance_id,
             policy.name,
         )
+        if self.on_instance_loss is not None:
+            self.on_instance_loss(policy, event)

@@ -1,0 +1,104 @@
+"""Automated instance-loss recovery: detect → recreate → resume.
+
+The reference documents this loop but leaves every step to the operator:
+the master self-heals only via its ASG (StackSetup.md:113-114), worker
+replacement never updates cluster metadata (StackSetup.md:107-108), and the
+prescribed remedy is "delete the stack, recreate reusing the EFS, restart
+from checkpoint" (examples/distributed-tensorflow/README.md:85-87).  Round
+1 automated the middle step (``Provisioner.recover()``); this module closes
+the loop: the elasticity controller's terminate events *trigger* recovery,
+and training resumes from the checkpoints that survived on retained
+storage.
+
+On TPU the whole-slice recreate is the right granularity for any loss — a
+slice is one logical machine, so a lost coordinator and a lost worker leave
+the same stale contract (unlike the reference's asymmetric master/worker
+story).  ``RecoveryManager`` therefore arms on every post-freeze loss in a
+managed group.
+
+Deliberate split between *detection* (event-driven, may fire mid-step) and
+*recovery* (pulled at a safe point): lifecycle events arrive inside
+describe/poll calls, where tearing down the very backend state being
+described would re-enter the event bus.  Callers check ``needs_recovery``
+between training episodes — or just use :func:`run_with_recovery`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+from deeplearning_cfn_tpu.cluster.elasticity import GroupPolicy
+from deeplearning_cfn_tpu.provision.events import LifecycleEvent
+from deeplearning_cfn_tpu.provision.provisioner import ProvisionResult, Provisioner
+from deeplearning_cfn_tpu.utils.logging import get_logger
+
+log = get_logger("dlcfn.recovery")
+
+
+@dataclass
+class RecoveryManager:
+    """Arms on instance-loss events; performs recover-and-rearm on demand."""
+
+    provisioner: Provisioner
+    losses: list[LifecycleEvent] = field(default_factory=list)
+
+    def attach(self, result: ProvisionResult) -> None:
+        """Subscribe to the live controller (re-call after every recover —
+        each provisioning generation has a fresh controller)."""
+        result.controller.on_instance_loss = self._on_loss
+
+    def _on_loss(self, policy: GroupPolicy, event: LifecycleEvent) -> None:
+        self.losses.append(event)
+        log.warning(
+            "armed for recovery: lost %s in group %s (%d losses pending)",
+            event.instance_id,
+            policy.name,
+            len(self.losses),
+        )
+
+    @property
+    def needs_recovery(self) -> bool:
+        return bool(self.losses)
+
+    def recover(self) -> ProvisionResult:
+        """Recreate the cluster (reusing retained storage), re-arm on the
+        new controller, and return the fresh result.  Checkpoints on the
+        reused storage make the subsequent training episode resume via
+        ``Checkpointer.restore_latest``."""
+        lost = [e.instance_id for e in self.losses]
+        self.losses.clear()
+        log.warning("recovering cluster after instance loss: %s", lost)
+        result = self.provisioner.recover()
+        self.attach(result)
+        return result
+
+
+def run_with_recovery(
+    provisioner: Provisioner,
+    train_once: Callable[[ProvisionResult], dict],
+    max_recoveries: int = 1,
+) -> tuple[dict, ProvisionResult, int]:
+    """provision → train → (on loss: recover → resume) loop.
+
+    ``train_once(result)`` runs one training episode against a live
+    cluster and returns its metrics; it is responsible for checkpointing
+    (and for restoring, which makes resumption automatic).  Returns the
+    last episode's metrics, the final provision result, and how many
+    recoveries happened.
+    """
+    result = provisioner.provision()
+    manager = RecoveryManager(provisioner)
+    manager.attach(result)
+    recoveries = 0
+    while True:
+        out = train_once(result)
+        if not manager.needs_recovery:
+            return out, result, recoveries
+        if recoveries >= max_recoveries:
+            raise RuntimeError(
+                f"instance loss after {max_recoveries} recoveries; giving up "
+                f"(pending: {[e.instance_id for e in manager.losses]})"
+            )
+        recoveries += 1
+        result = manager.recover()
