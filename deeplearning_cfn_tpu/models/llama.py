@@ -301,7 +301,17 @@ def forward_with_aux(
     configs) — added to the training objective, excluded from perplexity.
     """
     B, S = tokens.shape
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    # The stored table is P("tp", "fsdp"); gathering from it directly makes
+    # the lookup output emb-sharded over fsdp, and GSPMD cannot reshard
+    # {emb: fsdp} -> {batch: fsdp, seq: sp} without replicating the whole
+    # activation ("involuntary full rematerialization", the round-1 dryrun
+    # warning).  Constraining the bf16 working copy to P("tp", None) keeps
+    # vocab sharded (the large axis) while the gather output inherits the
+    # token sharding (batch over dp/fsdp, seq over sp) plus an unsharded
+    # emb axis — exactly the activation layout, so the constraint below is
+    # a no-op instead of a blocking reshard.
+    table = _maybe_shard(params["embed"].astype(cfg.dtype), P("tp", None))
+    x = table[tokens]
     x = _maybe_shard(x, P(("dp", "fsdp"), "sp", None))
     positions = jnp.arange(S, dtype=jnp.int32)
 
