@@ -159,3 +159,33 @@ def from_hf(model: Any, dtype: Any = jnp.bfloat16) -> tuple[LlamaConfig, dict]:
     """(config, params) from a live ``transformers.LlamaForCausalLM``."""
     cfg = config_from_hf(model.config, dtype=dtype)
     return cfg, from_hf_state_dict(cfg, model.state_dict())
+
+
+def expected_hf_shapes(cfg: LlamaConfig) -> dict[str, tuple[int, ...]]:
+    """The HF ``LlamaForCausalLM`` state-dict shapes this importer expects
+    for a config — the shape-level contract of ``from_hf_state_dict``.
+
+    Lets 8B-scale import be *verified at shapes* (tests/test_llama_import)
+    without materializing ~16 GB of tensors: generate this dict, feed
+    zero-stride broadcast views of the right shapes through the importer at
+    tiny scale, and check this table against HF's published 8B geometry.
+    """
+    d, hd = cfg.dim, cfg.head_dim
+    shapes: dict[str, tuple[int, ...]] = {
+        "model.embed_tokens.weight": (cfg.vocab_size, d),
+        "model.norm.weight": (d,),
+    }
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        shapes[p + "input_layernorm.weight"] = (d,)
+        shapes[p + "self_attn.q_proj.weight"] = (cfg.n_heads * hd, d)
+        shapes[p + "self_attn.k_proj.weight"] = (cfg.n_kv_heads * hd, d)
+        shapes[p + "self_attn.v_proj.weight"] = (cfg.n_kv_heads * hd, d)
+        shapes[p + "self_attn.o_proj.weight"] = (d, cfg.n_heads * hd)
+        shapes[p + "post_attention_layernorm.weight"] = (d,)
+        shapes[p + "mlp.gate_proj.weight"] = (cfg.mlp_dim, d)
+        shapes[p + "mlp.up_proj.weight"] = (cfg.mlp_dim, d)
+        shapes[p + "mlp.down_proj.weight"] = (d, cfg.mlp_dim)
+    if not cfg.tied_embeddings:
+        shapes["lm_head.weight"] = (cfg.vocab_size, d)
+    return shapes

@@ -1,0 +1,218 @@
+"""8B-scale feasibility accounting: eval_shape memory report + AOT checks.
+
+BASELINE.json's config 5 calls for Llama-3 8B FSDP x TP on a v5p-32 slice.
+Nothing in the reference speaks to this scale (SURVEY §7 hard part #3), so
+the feasibility evidence is built here from first principles:
+
+- ``memory_report``: per-chip HBM accounting from ``jax.eval_shape`` over
+  the real parameter tree and the real PartitionSpecs — no tensor is ever
+  materialized.  Covers params, optimizer moments, gradients, the
+  remat-checkpointed per-layer activations, and the logits buffer (the
+  usual silent killer at vocab 128256).
+- ``compile_check``: AOT-lowers (and optionally compiles) the full train
+  step at 8B shapes over a virtual mesh of the target topology — shape,
+  sharding, and partitioner errors surface without a single chip.
+
+Run ``python -m deeplearning_cfn_tpu.models.llama_memory`` to print the
+v5p-32 budget table (docs/MEMORY_8B.md is its committed output).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import numpy as np
+
+from deeplearning_cfn_tpu.models import llama
+from deeplearning_cfn_tpu.models.llama import LlamaConfig
+
+# Usable HBM per chip (GiB).  Book values; the XLA runtime reserves a slice,
+# so budgets below 90% utilization are the deployable ones.
+HBM_PER_CHIP_GIB = {
+    "v4": 32,
+    "v5litepod": 16,
+    "v5p": 95,
+    "v6e": 32,
+}
+
+
+def _shard_factor(spec, mesh_axes: dict[str, int]) -> int:
+    """How many ways a PartitionSpec divides an array on this mesh."""
+    factor = 1
+    for entry in spec:
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for name in names:
+            factor *= mesh_axes.get(name, 1)
+    return factor
+
+
+def _tree_bytes(shapes: Any, specs: Any, mesh_axes: dict[str, int]) -> int:
+    """Sharded per-chip bytes for a pytree of ShapeDtypeStructs."""
+    flat_shapes = jax.tree_util.tree_leaves(shapes)
+    flat_specs = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec)
+    )
+    total = 0
+    for leaf, spec in zip(flat_shapes, flat_specs):
+        nbytes = int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+        total += nbytes // _shard_factor(spec, mesh_axes)
+    return total
+
+
+@dataclass
+class MemoryReport:
+    cfg_name: str
+    mesh_axes: dict[str, int]
+    batch_global: int
+    seq_len: int
+    params_gib: float
+    optimizer_gib: float
+    gradients_gib: float
+    activations_gib: float
+    logits_gib: float
+    total_gib: float
+
+    def fits(self, chip: str = "v5p", utilization: float = 0.9) -> bool:
+        return self.total_gib <= HBM_PER_CHIP_GIB[chip] * utilization
+
+    def row(self) -> str:
+        axes = "x".join(f"{k}{v}" for k, v in self.mesh_axes.items() if v > 1)
+        return (
+            f"| {axes or 'replicated'} | {self.batch_global} | {self.seq_len} "
+            f"| {self.params_gib:.2f} | {self.optimizer_gib:.2f} "
+            f"| {self.gradients_gib:.2f} | {self.activations_gib:.2f} "
+            f"| {self.logits_gib:.2f} | **{self.total_gib:.2f}** |"
+        )
+
+
+def memory_report(
+    cfg: LlamaConfig,
+    mesh_axes: dict[str, int],
+    batch_global: int,
+    seq_len: int | None = None,
+    optimizer: str = "adamw",
+    cfg_name: str = "llama",
+) -> MemoryReport:
+    """Per-chip HBM accounting for one (config, mesh, batch) point.
+
+    Activation model (remat per layer, the forward_with_aux structure):
+    the checkpointed residual stream ([B, S, D] bf16 per layer) persists
+    through the backward, plus one block's live intermediates (q/k/v/attn
+    out + the SwiGLU gate/up pair) and the [B, S, V] f32 logits+grad pair.
+    Batch shards over dp*fsdp, sequence over sp, heads/mlp/vocab over tp.
+    """
+    seq_len = seq_len or cfg.max_seq_len
+    shapes = jax.eval_shape(partial(llama.init_params, cfg), jax.random.key(0))
+    specs = llama.param_specs(cfg)
+    params_b = _tree_bytes(shapes, specs, mesh_axes)
+    n_moments = {"adamw": 2, "lamb": 2, "momentum": 1, "sgd": 0}[optimizer]
+    optimizer_b = n_moments * params_b
+    gradients_b = params_b
+
+    batch_shards = mesh_axes.get("dp", 1) * mesh_axes.get("fsdp", 1)
+    seq_shards = mesh_axes.get("sp", 1)
+    tp = mesh_axes.get("tp", 1)
+    b_local = max(1, batch_global // batch_shards)
+    s_local = max(1, seq_len // seq_shards)
+    bf16 = 2
+    # Residual stream checkpointed once per layer.
+    act_b = cfg.n_layers * b_local * s_local * cfg.dim * bf16
+    # One live block: x, normed h, q, attn-out (dim each) + k/v (kv heads)
+    # + gate/up ([mlp_dim/tp] each, the widest tensors).
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+    act_b += b_local * s_local * (
+        4 * cfg.dim + 2 * kv_dim + 2 * (cfg.mlp_dim // tp)
+    ) * bf16
+    # Logits + their gradient, f32, vocab sharded over tp.
+    logits_b = 2 * b_local * s_local * (cfg.vocab_size // tp) * 4
+
+    gib = 1024**3
+    total = params_b + optimizer_b + gradients_b + act_b + logits_b
+    return MemoryReport(
+        cfg_name=cfg_name,
+        mesh_axes=dict(mesh_axes),
+        batch_global=batch_global,
+        seq_len=seq_len,
+        params_gib=params_b / gib,
+        optimizer_gib=optimizer_b / gib,
+        gradients_gib=gradients_b / gib,
+        activations_gib=act_b / gib,
+        logits_gib=logits_b / gib,
+        total_gib=total / gib,
+    )
+
+
+def compile_check(
+    cfg: LlamaConfig,
+    mesh_axes: dict[str, int],
+    batch_global: int,
+    seq_len: int,
+    compile: bool = False,
+) -> dict:
+    """AOT-lower (optionally compile) the full train step at the given
+    shapes over a virtual device mesh.  Lowering alone exercises tracing,
+    sharding propagation, and shape checking; ``compile=True`` adds the
+    XLA partitioner + backend pipeline (minutes of host time at 8B)."""
+    import time
+
+    from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
+    from deeplearning_cfn_tpu.train.trainer import TrainerConfig
+
+    n_devices = int(np.prod(list(mesh_axes.values())))
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} (virtual) devices, found {len(devices)}; set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices}"
+        )
+    mesh = build_mesh(MeshSpec(**mesh_axes), devices[:n_devices])
+    trainer = llama.make_trainer(
+        cfg,
+        mesh,
+        TrainerConfig(strategy="fsdp", optimizer="adamw", learning_rate=1e-4),
+    )
+    tok = jax.ShapeDtypeStruct(
+        (batch_global, seq_len), np.int32, sharding=trainer.batch_sharding
+    )
+    state_shapes = jax.eval_shape(
+        partial(trainer.init, jax.random.key(0)),
+        jax.ShapeDtypeStruct((1, seq_len), np.int32),
+    )
+    t0 = time.perf_counter()
+    with jax.set_mesh(mesh):
+        lowered = trainer.step_fn.lower(state_shapes, tok, tok)
+        out = {"lowered": True, "lower_seconds": time.perf_counter() - t0}
+        if compile:
+            compiled = lowered.compile()
+            out["compile_seconds"] = time.perf_counter() - t0 - out["lower_seconds"]
+            cost = compiled.cost_analysis() or {}
+            out["flops_per_step"] = cost.get("flops")
+    return out
+
+
+def main() -> None:
+    cfg = LlamaConfig.llama3_8b()
+    print("# Llama-3 8B per-chip HBM budget — v5p-32 (16 chips, 95 GiB/chip)\n")
+    print(
+        "| mesh | global batch | seq | params | adamw | grads | acts "
+        "| logits | total GiB/chip |"
+    )
+    print("|---|---|---|---|---|---|---|---|---|")
+    for mesh_axes, batch in (
+        ({"fsdp": 16, "tp": 1}, 16),
+        ({"fsdp": 8, "tp": 2}, 16),
+        ({"fsdp": 4, "tp": 4}, 16),
+        ({"fsdp": 8, "tp": 2}, 32),
+    ):
+        rep = memory_report(cfg, mesh_axes, batch_global=batch, cfg_name="llama3_8b")
+        fits = "fits" if rep.fits("v5p") else "DOES NOT FIT"
+        print(rep.row() + f" {fits}")
+
+
+if __name__ == "__main__":
+    main()
