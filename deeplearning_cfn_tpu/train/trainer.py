@@ -390,6 +390,7 @@ class Trainer:
         logger: ThroughputLogger | None = None,
         checkpointer: Any = None,
         stop_fn: Callable[[dict], bool] | None = None,
+        prefetch: int = 2,
     ) -> tuple[TrainState, list[float]]:
         """``stop_fn(metrics) -> True`` ends training early — the
         time-to-accuracy mode (the reference's only published CIFAR metric
@@ -403,49 +404,71 @@ class Trainer:
         how far dispatch runs ahead of the device and sets the
         early-stop granularity (set ``log_every=1`` for per-step
         stopping).
+
+        ``prefetch`` > 0 moves host-batch production and the
+        host->device transfer onto a background thread, ``prefetch``
+        batches ahead (train/data.py:DevicePrefetcher), so input IO
+        overlaps compute; 0 = inline transfers.  In every mode at most
+        ``steps`` batches are consumed from the caller's iterator (an
+        early ``stop_fn`` exit may have pulled up to ``prefetch`` of
+        those ahead without training on them).
         """
+        from deeplearning_cfn_tpu.train.data import DevicePrefetcher
+
         losses: list[float] = []
         pending: list[jax.Array] = []  # device scalars awaiting readback
         step_fn = self.step_fn
         sync_every = max(1, int(self.config.log_every))
         t_fit = time.perf_counter()
+        # islice in every mode: fit consumes exactly `steps` items from the
+        # caller's iterator (a break-based guard would pull one extra).
+        batches = itertools.islice(batches, steps)
+        prefetcher: DevicePrefetcher | None = None
+        if prefetch > 0:
+            batches = prefetcher = DevicePrefetcher(
+                batches, self.batch_sharding, prefetch
+            )
         # Global step tracked host-side (syncing state.step every iteration
         # would stall the dispatch pipeline); resume-aware so checkpoints
         # after a restore are labeled with the true training step.
         gstep = int(jax.device_get(state.step))
-        for i, batch in enumerate(batches):
-            if i >= steps:
-                break
-            # Targets may be a pytree (e.g. detection {boxes, classes});
-            # every leaf leads with the batch axis, so one batch sharding
-            # applies uniformly — a single host->device transfer per batch.
-            x = jax.device_put(batch.x, self.batch_sharding)
-            y = jax.device_put(batch.y, self.batch_sharding)
-            with jax.set_mesh(self.mesh):
-                state, metrics = step_fn(state, x, y)
-            gstep += 1
-            pending.append(metrics["loss"])
-            if i == 0:
-                # Time-to-first-step (includes compile) — one half of the
-                # driver's template-to-first-step wallclock metric; the
-                # block is one-time and doubles as compile completion.
-                jax.block_until_ready(metrics["loss"])
-                self.first_step_seconds = time.perf_counter() - t_fit
-                self.first_step_at = time.perf_counter()
-            if logger:
-                # The logger converts to float only at its own log_every
-                # boundaries — passing the device scalar keeps non-log
-                # steps sync-free.
-                logger.step(gstep, metrics["loss"])
-            if checkpointer is not None and checkpointer.should_save(gstep):
-                checkpointer.save(gstep, state)
-            if gstep % sync_every == 0 or i == steps - 1:
-                # The host blocks here anyway, so drain the pending device
-                # scalars — O(log_every) live buffers instead of O(steps).
-                losses.extend(float(v) for v in jax.device_get(pending))
-                pending.clear()
-                if stop_fn is not None and stop_fn(metrics):
-                    break
+        try:
+            for i, batch in enumerate(batches):
+                # Targets may be a pytree (e.g. detection {boxes, classes});
+                # every leaf leads with the batch axis, so one batch sharding
+                # applies uniformly — a single host->device transfer per batch
+                # (a no-op for already-placed prefetched batches).
+                x = jax.device_put(batch.x, self.batch_sharding)
+                y = jax.device_put(batch.y, self.batch_sharding)
+                with jax.set_mesh(self.mesh):
+                    state, metrics = step_fn(state, x, y)
+                gstep += 1
+                pending.append(metrics["loss"])
+                if i == 0:
+                    # Time-to-first-step (includes compile) — one half of the
+                    # driver's template-to-first-step wallclock metric; the
+                    # block is one-time and doubles as compile completion.
+                    jax.block_until_ready(metrics["loss"])
+                    self.first_step_seconds = time.perf_counter() - t_fit
+                    self.first_step_at = time.perf_counter()
+                if logger:
+                    # The logger converts to float only at its own log_every
+                    # boundaries — passing the device scalar keeps non-log
+                    # steps sync-free.
+                    logger.step(gstep, metrics["loss"])
+                if checkpointer is not None and checkpointer.should_save(gstep):
+                    checkpointer.save(gstep, state)
+                if gstep % sync_every == 0 or i == steps - 1:
+                    # The host blocks here anyway, so drain the pending device
+                    # scalars — O(log_every) live buffers instead of O(steps).
+                    losses.extend(float(v) for v in jax.device_get(pending))
+                    pending.clear()
+                    if stop_fn is not None and stop_fn(metrics):
+                        break
+        finally:
+            # Exceptions mid-loop must not leak a live producer thread.
+            if prefetcher is not None:
+                prefetcher.close()
         losses.extend(float(v) for v in jax.device_get(pending))
         return state, losses
 

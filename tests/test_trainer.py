@@ -174,6 +174,67 @@ def test_evaluate_empty_iterator():
     assert trainer.evaluate(state, iter([]))["examples"] == 0
 
 
+def test_fit_prefetch_matches_inline_and_bounds_consumption():
+    """prefetch moves transfers to a background thread but must not change
+    the training trajectory, and fit(steps=N, prefetch=k) consumes at most
+    N batches from the caller's iterator."""
+    ds = SyntheticDataset.mnist_like(batch_size=32)
+    sample = next(iter(ds.batches(1)))
+    results = {}
+    for prefetch in (0, 2):
+        mesh = build_mesh(MeshSpec(dp=8))
+        trainer = Trainer(
+            LeNet(), mesh, TrainerConfig(learning_rate=0.05, matmul_precision="float32")
+        )
+        state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
+        state, losses = trainer.fit(
+            state, ds.batches(6), steps=6, prefetch=prefetch
+        )
+        results[prefetch] = losses
+    np.testing.assert_allclose(results[0], results[2], rtol=1e-6)
+
+    # Consumption bound: islice keeps the prefetcher from draining the
+    # caller's iterator past `steps`.
+    mesh = build_mesh(MeshSpec(dp=8))
+    trainer = Trainer(
+        LeNet(), mesh, TrainerConfig(learning_rate=0.05, matmul_precision="float32")
+    )
+    state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
+    src = iter(list(ds.batches(8)))
+    trainer.fit(state, src, steps=3, prefetch=2)
+    assert len(list(src)) == 5  # 8 - 3 consumed
+
+
+def test_device_prefetcher_propagates_errors_and_closes():
+    from deeplearning_cfn_tpu.train.data import Batch, DevicePrefetcher
+    from jax.sharding import NamedSharding
+
+    mesh = build_mesh(MeshSpec(dp=8))
+    sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+
+    def bad_batches():
+        yield Batch(
+            x=np.zeros((8, 4), np.float32), y=np.zeros((8,), np.int32)
+        )
+        raise RuntimeError("loader exploded")
+
+    pf = DevicePrefetcher(bad_batches(), sharding, size=2)
+    it = iter(pf)
+    next(it)
+    with pytest.raises(RuntimeError, match="loader exploded"):
+        next(it)
+    pf.close()
+
+    # close() before exhaustion stops the producer without hanging.
+    pf2 = DevicePrefetcher(
+        iter([Batch(x=np.zeros((8, 4), np.float32), y=np.zeros((8,), np.int32))] * 100),
+        sharding,
+        size=1,
+    )
+    next(iter(pf2))
+    pf2.close()
+
+
 def test_evaluate_does_not_overconsume_iterator():
     """Regression: evaluate(steps=N) must take exactly N batches from the
     caller's iterator (a break-based loop pulled and discarded N+1)."""
