@@ -70,6 +70,20 @@ def test_gradients_match(hq, hkv):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
 
 
+def test_block_picker_minimizes_padding():
+    """Effective block selection: keep the big (fast) block for aligned
+    sequences, step down for ragged ones instead of paying up to 2.5x in
+    padded attention FLOPs (512-block on S=600 would pad to 1024)."""
+    from deeplearning_cfn_tpu.ops.pallas_attention import _clamp_block
+
+    assert _clamp_block(512, 2048) == 512  # aligned: biggest block wins
+    assert _clamp_block(512, 4096) == 512
+    assert _clamp_block(512, 128) == 128  # short seq: clamp to length
+    assert _clamp_block(128, 8) == 16  # sublane floor
+    assert _clamp_block(512, 600) == 32  # 608 = 19*32: zero padding
+    assert _clamp_block(512, 640) == 128  # 640 = 5*128: zero padding
+
+
 def test_bad_gqa_ratio_raises():
     q, k, v = _qkv(hq=6, hkv=4)
     with pytest.raises(ValueError, match="multiple of kv heads"):
