@@ -254,12 +254,26 @@ class GCPBackend(Backend):
         state = resp.get("state", {}).get("state", "CREATING")
         nodes = []
         if state in ("ACTIVE", "PROVISIONING", "DEGRADED"):
-            listing = self.transport("GET", f"{self._parent()}/nodes", None)
-            for node in listing.get("nodes", []):
-                if node.get("name", "").endswith(f"/{name}") or node.get(
-                    "labels", {}
-                ).get("group") == name:
-                    nodes.append(node)
+            # create_group makes exactly one node with nodeId == group name,
+            # so fetch it directly rather than listing the zone (round-1
+            # used a list + name-suffix/label heuristic: O(zone) per poll
+            # and wrong if an unrelated node shared the suffix).
+            try:
+                nodes = [
+                    self.transport(
+                        "GET", f"{self._parent()}/nodes/{name}", None
+                    )
+                ]
+            except KeyError:
+                # Node object not materialized yet (or an out-of-band
+                # multi-node QR): fall back to the list + exact-match scan.
+                listing = self.transport("GET", f"{self._parent()}/nodes", None)
+                nodes = [
+                    node
+                    for node in listing.get("nodes", [])
+                    if node.get("name", "").endswith(f"/{name}")
+                    or node.get("labels", {}).get("group") == name
+                ]
         return state, nodes
 
     def describe_group(self, name: str) -> WorkerGroup:
@@ -305,10 +319,14 @@ class GCPBackend(Backend):
         return group, qr_state
 
     def describe_instances(self, instance_ids: list[str]) -> list[Instance]:
+        # Instance ids are "{group}-w{idx}" by construction (_describe), so
+        # describe only the groups actually referenced instead of
+        # re-describing every known group per call.
+        wanted_groups = {
+            iid.rsplit("-w", 1)[0] for iid in instance_ids if "-w" in iid
+        }
         out = []
-        for name in self._groups:
-            if name.startswith("_"):
-                continue
+        for name in wanted_groups & set(self._groups):
             for inst in self.describe_group(name).instances:
                 if inst.instance_id in instance_ids:
                     out.append(inst)
@@ -531,8 +549,13 @@ class FakeGCPTransport:
             self._polls[name] = n
             state = "ACTIVE" if n >= self.provision_polls else "PROVISIONING"
             return {"state": {"state": state}}
-        if method == "GET" and path.endswith("/nodes"):
-            name = next(iter(self._created), "workers")
+        if method == "GET" and ("/nodes/" in path or path.endswith("/nodes")):
+            if "/nodes/" in path:
+                name = path.rsplit("/", 1)[-1]
+                if name not in self._created:
+                    raise KeyError(path)
+            else:
+                name = next(iter(self._created), "workers")
             ready = self._polls.get(name, 0) >= self.provision_polls
             endpoints = []
             for i in range(self.workers):
@@ -546,5 +569,5 @@ class FakeGCPTransport:
                     e for i, e in enumerate(endpoints) if i not in self.failed_workers
                 ],
             }
-            return {"nodes": [node]}
+            return node if "/nodes/" in path else {"nodes": [node]}
         return {}
