@@ -58,6 +58,7 @@ def main(argv: list[str] | None = None) -> dict:
             TrainerConfig(
                 strategy=args.strategy, optimizer="adamw",
                 learning_rate=1e-3, grad_clip_norm=1.0,
+                log_every=args.log_every,
             ),
             loss_fn=bert.mlm_loss(encoder),
         )
@@ -80,6 +81,7 @@ def main(argv: list[str] | None = None) -> dict:
             optimizer="adamw",
             learning_rate=args.learning_rate or 3e-4,
             grad_clip_norm=1.0,
+            log_every=args.log_every,
         ),
     )
     ds = SyntheticSeqClassificationDataset(

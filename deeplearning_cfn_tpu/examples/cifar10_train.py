@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from deeplearning_cfn_tpu.examples.common import (
     base_parser,
     default_mesh,
-    image_batches,
+    image_pipeline,
     maybe_init_distributed,
     metrics_sink,
 )
@@ -60,6 +60,10 @@ def main(argv: list[str] | None = None) -> dict:
         num_classes=10,
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
     )
+    ds = SyntheticDataset(
+        shape=(32, 32, 3), num_classes=10, batch_size=batch, noise_scale=1.0
+    )
+    batches, input_stats = image_pipeline(args, (32, 32, 3), ds)
     trainer = Trainer(
         model,
         mesh,
@@ -68,12 +72,13 @@ def main(argv: list[str] | None = None) -> dict:
             learning_rate=lr,
             has_train_arg=True,
             optimizer="momentum",
+            # Sync/early-stop cadence follows the CLI flag (log_every=1 =>
+            # per-step stop_fn, the time-to-accuracy mode).
+            log_every=args.log_every,
+            # uint8 records normalize inside the jitted step (fast path).
+            input_stats=input_stats,
         ),
     )
-    ds = SyntheticDataset(
-        shape=(32, 32, 3), num_classes=10, batch_size=batch, noise_scale=1.0
-    )
-    batches = image_batches(args, (32, 32, 3), ds)
     sample = next(iter(batches(1)))
     state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
     ckpt = None
@@ -116,11 +121,35 @@ def main(argv: list[str] | None = None) -> dict:
     if args.eval_steps:
         import copy
 
+        def eval_pipeline(eargs):
+            # Same raw-uint8/in-step-normalize contract as training when
+            # the trainer carries input_stats AND the eval dir pins the
+            # same normalization identity; otherwise fall back to host
+            # normalization with the eval dir's OWN stats (float batches
+            # bypass in-step normalization) — silently normalizing
+            # held-out data with training stats would skew the metric.
+            from deeplearning_cfn_tpu.examples.common import image_batches
+
+            if input_stats is not None:
+                batches_fn, eval_stats = image_pipeline(
+                    eargs, (32, 32, 3), ds, eval_mode=True
+                )
+                if eval_stats == input_stats:
+                    return batches_fn
+                from deeplearning_cfn_tpu.utils.logging import get_logger
+
+                get_logger("dlcfn.examples").warning(
+                    "eval records pin different normalization stats than "
+                    "training (%s vs %s); using the eval dir's own stats "
+                    "host-side", eval_stats, input_stats,
+                )
+            return image_batches(eargs, (32, 32, 3), ds, eval_mode=True)
+
         if args.eval_data_dir:
             # Operator-staged held-out records.
             eval_args = copy.copy(args)
             eval_args.data_dir = args.eval_data_dir
-            eval_batches = image_batches(eval_args, (32, 32, 3), ds, eval_mode=True)
+            eval_batches = eval_pipeline(eval_args)
             split = "heldout"
         elif args.data_dir:
             # eval_mode picks the test/val split when the converter staged
@@ -129,7 +158,7 @@ def main(argv: list[str] | None = None) -> dict:
             # for held-out accuracy.
             from deeplearning_cfn_tpu.examples.common import has_heldout_split
 
-            eval_batches = image_batches(args, (32, 32, 3), ds, eval_mode=True)
+            eval_batches = eval_pipeline(args)
             split = "heldout" if has_heldout_split(args.data_dir) else "train"
         else:
             # Synthetic: same task (template_seed matches the training

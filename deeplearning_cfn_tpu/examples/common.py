@@ -108,10 +108,19 @@ def metrics_sink(args, run_name: str):
     return JsonlMetricsSink.for_run(args.metrics_dir, run_name)
 
 
-def image_batches(args, image_shape, fallback_ds, eval_mode: bool = False):
-    """Batches for an image trainer: DLC1 records through the native
-    loader when ``--data_dir`` is set (first existing candidate dir wins,
-    the run.sh:21-35 data-source probe), else the synthetic dataset.
+def image_pipeline(args, image_shape, fallback_ds, eval_mode: bool = False):
+    """(batches_fn, input_stats) for an image trainer: DLC1 records
+    through the native loader when ``--data_dir`` is set (first existing
+    candidate dir wins, the run.sh:21-35 data-source probe), else the
+    synthetic dataset.
+
+    uint8 records (real-dataset converters) are yielded RAW: the second
+    return value is the per-channel (mean, std) for
+    ``TrainerConfig.input_stats``, so normalization runs inside the jitted
+    step.  Host-side float normalization caps the pipeline at ~400
+    imagenet-rec/s/core while the uint8 path sustains thousands, and uint8
+    halves host->device bytes (docs/BENCH_NOTES.md).  Float records and
+    synthetic data return ``None`` stats.
 
     Every process feeds the trainer the full global batch (the fit()
     contract), so in multi-process runs the record stream must be
@@ -120,15 +129,15 @@ def image_batches(args, image_shape, fallback_ds, eval_mode: bool = False):
     to the `make_array_from_process_local_data` path
     (examples/multiprocess_smoke.py), not here.
 
-    ``eval_mode`` gives an unshuffled single pass for held-out scoring.
-    Returns ``fn(steps) -> iterator[Batch]``.
+    ``eval_mode`` gives an unshuffled single pass over the test/val split
+    (when staged) for held-out scoring.
     """
     if not args.data_dir:
-        return fallback_ds.batches
+        return fallback_ds.batches, None
     from pathlib import Path
 
     from deeplearning_cfn_tpu.train.data import probe_data_source
-    from deeplearning_cfn_tpu.train.datasets import STATS, normalized_batches
+    from deeplearning_cfn_tpu.train.datasets import STATS, read_stats_sidecar
     from deeplearning_cfn_tpu.train.native_loader import NativeRecordLoader
     from deeplearning_cfn_tpu.train.records import RecordSpec, read_header
 
@@ -151,9 +160,9 @@ def image_batches(args, image_shape, fallback_ds, eval_mode: bool = False):
     record_size, _ = read_header(paths[0])
     spec = RecordSpec.classification(image_shape)
     u8_spec = RecordSpec.classification(image_shape, "uint8")
-    normalize = False
-    if record_size == u8_spec.record_size != spec.record_size:
-        spec, normalize = u8_spec, True
+    is_u8 = record_size == u8_spec.record_size != spec.record_size
+    if is_u8:
+        spec = u8_spec
     multi = jax.process_count() > 1
     loader = NativeRecordLoader(
         paths,
@@ -169,11 +178,10 @@ def image_batches(args, image_shape, fallback_ds, eval_mode: bool = False):
         "data%s: %d record files under %s (%d records, %d batches/epoch%s)",
         " [eval]" if eval_mode else "", len(paths), root,
         loader.shard_records, loader.batches_per_epoch,
-        ", uint8+normalize" if normalize else "",
+        ", uint8 (in-step normalize)" if is_u8 else "",
     )
-    if not normalize:
-        return loader.batches
-    from deeplearning_cfn_tpu.train.datasets import read_stats_sidecar
+    if not is_u8:
+        return loader.batches, None
 
     # The converter pins the normalization identity in stats.json; the
     # shape-based guess is only a fallback for hand-rolled record dirs.
@@ -195,11 +203,34 @@ def image_batches(args, image_shape, fallback_ds, eval_mode: bool = False):
             root, guess, tuple(image_shape),
         )
         stats = STATS[guess]
+    input_stats = (tuple(stats.mean.tolist()), tuple(stats.std.tolist()))
     flip = bool(getattr(args, "augment_flip", False)) and not eval_mode
+    if not flip:
+        return loader.batches, input_stats
+    from deeplearning_cfn_tpu.train.datasets import flipped_batches
 
     def batches(steps):
-        return normalized_batches(
-            loader.batches(steps), stats.mean, stats.std, flip=flip
-        )
+        # copy=True: the loader's decode reuses buffers batch-to-batch.
+        return flipped_batches(loader.batches(steps), copy=True)
 
-    return batches
+    return batches, input_stats
+
+
+def image_batches(args, image_shape, fallback_ds, eval_mode: bool = False):
+    """Back-compat wrapper over :func:`image_pipeline` that normalizes
+    uint8 records on the HOST (slow path; see image_pipeline).  Prefer
+    image_pipeline + ``TrainerConfig.input_stats``."""
+    import numpy as np
+
+    from deeplearning_cfn_tpu.train.datasets import normalized_batches
+
+    batches, input_stats = image_pipeline(args, image_shape, fallback_ds, eval_mode)
+    if input_stats is None:
+        return batches
+    mean = np.asarray(input_stats[0], np.float32)
+    std = np.asarray(input_stats[1], np.float32)
+
+    def host_normalized(steps):
+        return normalized_batches(batches(steps), mean, std, flip=False)
+
+    return host_normalized

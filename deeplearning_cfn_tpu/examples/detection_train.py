@@ -126,6 +126,7 @@ def main(argv: list[str] | None = None) -> dict:
             has_train_arg=True,
             optimizer=args.optimizer,
             grad_clip_norm=10.0,
+            log_every=args.log_every,
         ),
         stateful_loss_fn=loss_fn,
     )

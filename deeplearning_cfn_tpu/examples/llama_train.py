@@ -74,6 +74,7 @@ def main(argv: list[str] | None = None) -> dict:
             learning_rate=args.learning_rate or 3e-4,
             weight_decay=0.1,
             grad_clip_norm=1.0,
+            log_every=args.log_every,
         ),
     )
     ds = SyntheticTokenDataset(

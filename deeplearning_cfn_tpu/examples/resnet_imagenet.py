@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from deeplearning_cfn_tpu.examples.common import (
     base_parser,
     default_mesh,
-    image_batches,
+    image_pipeline,
     maybe_init_distributed,
     metrics_sink,
 )
@@ -44,6 +44,10 @@ def main(argv: list[str] | None = None) -> dict:
     lr = args.learning_rate or 0.1
     mesh = default_mesh(args.strategy)
     model = DEPTHS[args.depth](dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+    ds = SyntheticDataset.imagenet_like(batch_size=batch, image_size=args.image_size)
+    batches, input_stats = image_pipeline(
+        args, (args.image_size, args.image_size, 3), ds
+    )
     trainer = Trainer(
         model,
         mesh,
@@ -52,10 +56,11 @@ def main(argv: list[str] | None = None) -> dict:
             learning_rate=lr,
             has_train_arg=True,
             label_smoothing=0.1,
+            log_every=args.log_every,
+            # uint8 records normalize inside the jitted step (fast path).
+            input_stats=input_stats,
         ),
     )
-    ds = SyntheticDataset.imagenet_like(batch_size=batch, image_size=args.image_size)
-    batches = image_batches(args, (args.image_size, args.image_size, 3), ds)
     sample = next(iter(batches(1)))
     state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
     logger = ThroughputLogger(

@@ -95,6 +95,24 @@ def normalize_images(
     return ((x_u8.astype(np.float32) / 255.0) - mean) / std
 
 
+def flipped_batches(
+    batches: Iterator[Batch], seed: int = 0, copy: bool = False
+) -> Iterator[Batch]:
+    """Horizontal-flip augmentation (per-image coin flip, [B, H, W, C]
+    layout) — the one shared implementation for both the uint8 fast path
+    and the host-normalized float path.  ``copy=True`` leaves the source
+    batch untouched (required when the source yields reused buffers)."""
+    rng = np.random.default_rng(seed)
+    for b in batches:
+        flips = rng.random(len(b.x)) < 0.5
+        x = b.x
+        if flips.any():
+            if copy:
+                x = x.copy()
+            x[flips] = x[flips, :, ::-1]
+        yield Batch(x=x, y=b.y)
+
+
 def normalized_batches(
     batches: Iterator[Batch],
     mean: np.ndarray,
@@ -104,13 +122,14 @@ def normalized_batches(
 ) -> Iterator[Batch]:
     """Wrap a uint8-image batch stream with normalization (+ optional
     horizontal-flip augmentation, host-side and cheap)."""
-    rng = np.random.default_rng(seed)
-    for b in batches:
-        x = normalize_images(b.x, mean, std)
-        if flip:
-            flips = rng.random(len(x)) < 0.5
-            x[flips] = x[flips, :, ::-1]
-        yield Batch(x=x, y=b.y)
+
+    def normalized():
+        for b in batches:
+            yield Batch(x=normalize_images(b.x, mean, std), y=b.y)
+
+    # normalize_images allocates fresh float arrays, so in-place flips are
+    # safe without a copy.
+    return flipped_batches(normalized(), seed=seed) if flip else normalized()
 
 
 # --- CIFAR-10 ----------------------------------------------------------------

@@ -79,6 +79,13 @@ class TrainerConfig:
     matmul_precision: str | None = None
     bf16_compute: bool = False
     remat: bool = False
+    # Per-channel (mean, std) in the /255 domain for uint8 image inputs.
+    # When set, normalization runs INSIDE the jitted step (XLA fuses it
+    # into the first conv) instead of on the host: measured on this repo's
+    # loader, host-side float normalization caps the input pipeline at
+    # ~400 imagenet-rec/s/core while the uint8 path sustains thousands
+    # (docs/BENCH_NOTES.md) — and uint8 halves host->device bytes vs bf16.
+    input_stats: tuple[tuple[float, ...], tuple[float, ...]] | None = None
     grad_clip_norm: float | None = None
     label_smoothing: float = 0.0
     lr_schedule: optax.Schedule | None = None
@@ -161,6 +168,17 @@ class Trainer:
         self.first_step_at: float | None = None
 
     # --- loss -----------------------------------------------------------
+    def _normalize_input(self, x: jax.Array) -> jax.Array:
+        """In-step uint8 normalization (config.input_stats); float inputs
+        pass through untouched so synthetic/pre-normalized paths are
+        unchanged."""
+        stats = self.config.input_stats
+        if stats is None or x.dtype != jnp.uint8:
+            return x
+        mean = jnp.asarray(stats[0], jnp.float32)
+        std = jnp.asarray(stats[1], jnp.float32)
+        return (x.astype(jnp.float32) / 255.0 - mean) / std
+
     def _default_objective(
         self, params: Any, model_state: Any, x: jax.Array, y: jax.Array, train: bool
     ) -> tuple[jax.Array, dict, Any]:
@@ -168,6 +186,7 @@ class Trainer:
         eval steps so their metrics stay numerically comparable.  Eval
         (train=False) disables dropout, reads BatchNorm running stats, and
         never mutates collections."""
+        x = self._normalize_input(x)
         if self.config.bf16_compute:
             x = x.astype(jnp.bfloat16)
         variables = {"params": params, **model_state}
@@ -201,8 +220,11 @@ class Trainer:
     def init(self, rng: jax.Array, sample_x: jax.Array) -> TrainState:
         """Initialize params/opt-state and place them on the mesh."""
         init_kwargs = {"train": False} if self.config.has_train_arg else {}
+        # uint8 batches (input_stats) normalize in-step; the model itself
+        # always sees float inputs, including at init.
+        sample = self._normalize_input(jnp.asarray(sample_x[:1]))
         variables = jax.eval_shape(
-            partial(self.model.init, rng, **init_kwargs), jnp.asarray(sample_x[:1])
+            partial(self.model.init, rng, **init_kwargs), sample
         )
         abstract_params = variables["params"]
         abstract_model_state = {k: v for k, v in variables.items() if k != "params"}
@@ -237,7 +259,7 @@ class Trainer:
                 model_state=model_state,
             )
 
-        return _init(rng, jnp.asarray(sample_x[:1]))
+        return _init(rng, sample)
 
     def _opt_state_shardings(self, abstract_params: Any, param_sh: Any) -> Any:
         """Optimizer state mirrors parameter sharding (moments are

@@ -280,6 +280,51 @@ def test_coco_records_train_and_eval_real_format(tmp_path):
     assert "mAP" in out["eval"] or out["eval"]  # accumulator produced a result
 
 
+def test_in_step_normalization_matches_host_path(tmp_path):
+    """TrainerConfig.input_stats: uint8 batches normalized inside the
+    jitted step must reproduce the host-normalized float trajectory —
+    the fast input path (docs/BENCH_NOTES.md: host normalization caps the
+    pipeline ~8x below what the raw-uint8 path sustains) changes layout,
+    not math."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning_cfn_tpu.models.lenet import LeNet
+    from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
+    from deeplearning_cfn_tpu.train.data import Batch
+    from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
+
+    rng = np.random.default_rng(0)
+    u8 = [
+        Batch(
+            x=rng.integers(0, 256, (16, 28, 28, 1), dtype=np.uint8),
+            y=rng.integers(0, 10, 16).astype(np.int32),
+        )
+        for _ in range(4)
+    ]
+    mean, std = datasets.MNIST_MEAN, datasets.MNIST_STD
+    host = [
+        Batch(x=datasets.normalize_images(b.x, mean, std), y=b.y) for b in u8
+    ]
+
+    losses = {}
+    for name, batches, stats in (
+        ("host", host, None),
+        ("device", u8, ((float(mean[0]),), (float(std[0]),))),
+    ):
+        mesh = build_mesh(MeshSpec(dp=8))
+        trainer = Trainer(
+            LeNet(),
+            mesh,
+            TrainerConfig(
+                learning_rate=0.05, matmul_precision="float32", input_stats=stats
+            ),
+        )
+        state = trainer.init(jax.random.key(0), jnp.asarray(batches[0].x))
+        _, losses[name] = trainer.fit(state, iter(batches), steps=4)
+    np.testing.assert_allclose(losses["host"], losses["device"], rtol=1e-5)
+
+
 def test_cli_convert_command(tmp_path, capsys):
     from deeplearning_cfn_tpu.cli import main
 
