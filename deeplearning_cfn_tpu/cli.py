@@ -249,7 +249,7 @@ def _hypothetical_contract(spec: ClusterSpec):
     plans/scripts without a live cluster."""
     from deeplearning_cfn_tpu.cluster.contract import ClusterContract
 
-    ips = [f"10.0.0.{i + 2}" for i in range(spec.pool.num_workers)]
+    ips = [f"10.0.0.{i + 2}" for i in range(spec.pool.total_workers)]
     return ClusterContract.build(
         cluster_name=spec.name,
         coordinator_ip=ips[0],
@@ -417,10 +417,48 @@ def cmd_run(args) -> int:
         job_args = []
         for k, v in sorted(spec.job.args.items()):
             job_args += [f"--{k}", str(v)]
-        runner = LocalJobRunner(plan)
         t_provisioned = time.monotonic()
-        out = runner.run(module.main, job_args)
-        record = {"job": spec.job.name, "result": out}
+        if getattr(args, "auto_recover", 0):
+            # provision -> train -> (on instance loss: recover -> resume)
+            # as one operator command; the job must checkpoint (set
+            # checkpoint_dir in the template's job args) for the resumed
+            # episode to continue rather than restart.
+            from deeplearning_cfn_tpu.cluster.recovery import (
+                RecoveryManager,
+            )
+
+            manager = RecoveryManager(prov)
+            manager.attach(result)
+            recoveries = 0
+            while True:
+                out = LocalJobRunner(plan).run(module.main, job_args)
+                if not manager.needs_recovery:
+                    break
+                if recoveries >= args.auto_recover:
+                    # Same exhaustion semantics as run_with_recovery: an
+                    # episode that ended with losses still pending is NOT
+                    # a success (its metrics ran on a lost cluster).
+                    print(
+                        f"RUN FAILED: instance loss after {recoveries} "
+                        f"recoveries (pending: "
+                        f"{[e.instance_id for e in manager.losses]})",
+                        file=sys.stderr,
+                    )
+                    return 1
+                recoveries += 1
+                result = manager.recover()
+                plan = build_launch_plan(
+                    result.contract, spec.job, result.job_violation
+                )
+            record = {
+                "job": spec.job.name,
+                "result": out,
+                "recoveries": recoveries,
+            }
+        else:
+            runner = LocalJobRunner(plan)
+            out = runner.run(module.main, job_args)
+            record = {"job": spec.job.name, "result": out}
         # The driver metric: template submission to the first completed
         # training step (the analog of the reference's 55-minute
         # stack-creation budget, README.md:80, measured not budgeted).
@@ -467,6 +505,17 @@ def main(argv: list[str] | None = None) -> int:
                 metavar="HOST:PORT",
                 help="rendezvous broker address; bootstrap agents run on the "
                 "VMs (production topology) instead of inline",
+            )
+        if name == "run":
+            p.add_argument(
+                "--auto-recover",
+                type=int,
+                default=0,
+                dest="auto_recover",
+                metavar="N",
+                help="on instance loss, recreate the cluster (reusing "
+                "retained storage) and rerun the job, up to N times; the "
+                "job resumes from its checkpoints",
             )
         if name == "delete":
             p.add_argument("--force-storage", action="store_true")

@@ -7,7 +7,11 @@ worker VM with the cluster identity in env:
   DLCFN_CLUSTER          cluster name (required)
   DLCFN_ROLE             coordinator | worker (default: coordinator iff
                          DLCFN_WORKER_INDEX == 0)
-  DLCFN_WORKER_INDEX     this VM's index in the slice (0 = coordinator)
+  DLCFN_WORKER_INDEX     this VM's index in its slice
+  DLCFN_SLICE            this VM's slice ordinal (default 0); worker 0 of
+                         slice 0 is the default coordinator, and the
+                         readiness ack carries the slice's group name so
+                         per-slice indices stay globally unique
   DLCFN_BROKER           host:port of the rendezvous broker (required —
                          without it the agent has no control plane)
   DLCFN_GROUPS           comma-separated worker-group names
@@ -60,9 +64,25 @@ def main() -> int:
         log.error("DLCFN_BROKER not set (need host:port); refusing to bootstrap")
         return 2
     index = int(os.environ.get("DLCFN_WORKER_INDEX", "0"))
-    role = os.environ.get("DLCFN_ROLE") or ("coordinator" if index == 0 else "worker")
+    slice_idx = int(os.environ.get("DLCFN_SLICE", "0") or "0")
+    role = os.environ.get("DLCFN_ROLE") or (
+        "coordinator" if index == 0 and slice_idx == 0 else "worker"
+    )
     host, port = broker.rsplit(":", 1)
     groups = os.environ.get("DLCFN_GROUPS", f"{cluster}-workers").split(",")
+    if not (0 <= slice_idx < len(groups)):
+        # A silent fallback here would collide readiness acks across
+        # slices and mask the misconfiguration; refuse to boot instead.
+        log.error(
+            "DLCFN_SLICE=%d out of range for DLCFN_GROUPS (%d groups); "
+            "refusing to bootstrap", slice_idx, len(groups),
+        )
+        return 2
+    # This VM's own group (slice): worker indices restart at 0 in every
+    # slice, so the readiness ack must carry the group to stay unique.
+    my_group = groups[slice_idx]
+    min_slices_env = os.environ.get("DLCFN_MIN_SLICES", "").strip()
+    min_slices = int(min_slices_env) if min_slices_env else None
     budget_s = float(os.environ.get("DLCFN_BOOTSTRAP_BUDGET_S", "2700"))
     poll_s = float(os.environ.get("DLCFN_POLL_INTERVAL_S", "30"))
 
@@ -100,6 +120,7 @@ def main() -> int:
         poll_interval_s=poll_s,
         storage_mount=os.environ.get("DLCFN_STORAGE_MOUNT", "/mnt/dlcfn"),
         group_signal_resources={g: f"group:{g}" for g in groups},
+        min_groups=min_slices,
     )
     try:
         if role == "coordinator":
@@ -112,7 +133,12 @@ def main() -> int:
             # master signaled; StackSetup.md:107-108 documents the
             # resulting stale-metadata trap.  This closes it.)
             backend.get_queue(f"{cluster}-ready-queue").send(
-                {"event": "worker-ready", "index": index, "cluster": cluster}
+                {
+                    "event": "worker-ready",
+                    "index": index,
+                    "group": my_group,
+                    "cluster": cluster,
+                }
             )
     except (BootstrapError, BudgetExhausted) as e:
         log.error("bootstrap failed: %s", e)

@@ -80,6 +80,14 @@ class BootstrapAgent:
     # SQS batch size from the reference (dl_cfn_setup_v2.py:36-37,139-141)
     receive_batch: int = 10
     visibility_timeout_s: float = 60.0
+    # Multi-slice degrade policy: None = every group must succeed (a group
+    # FAILURE aborts bootstrap); an int = the cluster proceeds as long as
+    # at least this many groups (slices) succeed, DROPPING failed ones from
+    # the contract — the slice-granularity shape of degrade-and-continue
+    # (a TPU slice fails whole, unlike an ASG that shrinks;
+    # lambda_function.py:142-169 is the per-instance original).
+    min_groups: int | None = None
+    failed_groups: set[str] = field(default_factory=set)
 
     # --- phase 1: credentials -------------------------------------------
     def wait_for_credentials(self) -> None:
@@ -101,16 +109,15 @@ class BootstrapAgent:
             # group resource; waiting out the whole budget would burn ~45
             # real minutes for an answer that is already known.
             signal_names = self.group_signal_resources or {}
-            for name in pending:
+            for name in list(pending):
                 if (
                     self.backend.get_resource_signal(
                         signal_names.get(name, f"group:{name}")
                     )
                     is ResourceSignal.FAILURE
                 ):
-                    raise BootstrapError(
-                        phase, f"group {name} failed to reach minimum capacity"
-                    )
+                    self._record_group_failure(phase, name)
+                    pending.discard(name)
             messages = self.coordinator_queue.receive(
                 max_messages=self.receive_batch,
                 visibility_timeout_s=self.visibility_timeout_s,
@@ -128,9 +135,14 @@ class BootstrapAgent:
                     log.info("duplicate group-setup for %s deduped", group)
                 elif group in pending:
                     if body.get("status") != "success":
-                        raise BootstrapError(
-                            phase, f"group {group} reported {body.get('status')!r}"
+                        self._record_group_failure(
+                            phase,
+                            str(group),
+                            f"reported {body.get('status')!r}",
                         )
+                        pending.discard(str(group))
+                        self.coordinator_queue.delete(msg.receipt)
+                        continue
                     results[group] = GroupSetupResult(
                         group=str(group),
                         launched=int(body.get("launched", 0)),
@@ -151,6 +163,34 @@ class BootstrapAgent:
                 self.budget.sleep(self.poll_interval_s, phase)
         return results
 
+    def _record_group_failure(
+        self, phase: str, name: str, cause: str = "failed to reach minimum capacity"
+    ) -> None:
+        """A group (slice) failed: abort unless the min_groups policy says
+        the cluster can proceed without it.
+
+        The coordinator slice (group_names[0]) is always required — it
+        hosts the agent running this very choreography, so it cannot be
+        dropped (the reference has the same asymmetry: the master ASG's
+        CreationPolicy fails the stack if the master doesn't launch,
+        deeplearning.template:669-674, while worker capacity degrades)."""
+        self.failed_groups.add(name)
+        surviving = len(self.group_names) - len(self.failed_groups)
+        if (
+            name == self.group_names[0]
+            or self.min_groups is None
+            or surviving < self.min_groups
+        ):
+            raise BootstrapError(phase, f"group {name} {cause}")
+        log.warning(
+            "dropping failed slice %s (%s); %d/%d slices remain (min %d)",
+            name, cause, surviving, len(self.group_names), self.min_groups,
+        )
+
+    @property
+    def surviving_groups(self) -> list[str]:
+        return [g for g in self.group_names if g not in self.failed_groups]
+
     # --- phase 3: instances active ---------------------------------------
     def wait_until_instances_active(self) -> dict[str, list[str]]:
         """Poll until every healthy instance of every group is RUNNING with
@@ -161,7 +201,7 @@ class BootstrapAgent:
             self.budget.check(phase)
             ips.clear()
             all_running = True
-            for name in self.group_names:
+            for name in self.surviving_groups:
                 group = self.backend.describe_group(name)
                 healthy = group.healthy_instances
                 running = [
@@ -194,8 +234,9 @@ class BootstrapAgent:
         self.wait_for_credentials()
         results = self.wait_for_group_success()
         ips_by_group = self.wait_until_instances_active()
+        surviving = self.surviving_groups
         if my_ip is None:
-            group0 = self.backend.describe_group(self.group_names[0])
+            group0 = self.backend.describe_group(surviving[0])
             me = min(
                 (
                     i
@@ -210,11 +251,13 @@ class BootstrapAgent:
                     "contract", "cannot resolve coordinator IP from group state"
                 )
             my_ip = me.private_ip
-        all_ips = [ip for name in self.group_names for ip in ips_by_group[name]]
-        degraded = any(r.degraded for r in results.values())
+        all_ips = [ip for name in surviving for ip in ips_by_group[name]]
+        degraded = any(r.degraded for r in results.values()) or bool(
+            self.failed_groups
+        )
         chips = max(
             self.backend.describe_group(name).chips_per_worker
-            for name in self.group_names
+            for name in surviving
         )
         contract = ClusterContract.build(
             cluster_name=self.cluster_name,

@@ -26,7 +26,10 @@ from __future__ import annotations
 import shlex
 
 from deeplearning_cfn_tpu.config.schema import ClusterSpec
-from deeplearning_cfn_tpu.provision.provisioner import worker_group_name
+from deeplearning_cfn_tpu.provision.provisioner import (
+    worker_group_name,
+    worker_group_names,
+)
 
 # Marker file guarding one-time shared-storage data placement — the
 # data.txt trick of mask-rcnn-cfn.yaml:784-789 (cfn-init `test:` guards).
@@ -173,14 +176,19 @@ def _agent_step(spec: ClusterSpec) -> list[str]:
         '[ -n "$DLCFN_BROKER" ] && break; sleep 2; done',
         'if [ -z "$DLCFN_BROKER" ]; then '
         "echo 'ERROR: broker address unavailable (metadata + env)'; exit 1; fi",
-        'if [ "$DLCFN_WORKER_INDEX" = "0" ]; then '
-        'DLCFN_ROLE="${DLCFN_ROLE:-coordinator}"; '
+        # Slice ordinal (multi-slice: one queued resource per slice, each
+        # with its own worker 0) — only slice 0's worker 0 coordinates.
+        f'DLCFN_SLICE="${{DLCFN_SLICE:-$({md}attributes/dlcfn-slice || true)}}"',
+        'if [ "$DLCFN_WORKER_INDEX" = "0" ] && [ "${DLCFN_SLICE:-0}" = "0" ]; '
+        'then DLCFN_ROLE="${DLCFN_ROLE:-coordinator}"; '
         'else DLCFN_ROLE="${DLCFN_ROLE:-worker}"; fi',
-        f'DLCFN_GROUPS="${{DLCFN_GROUPS:-{shlex.quote(worker_group_name(spec.name))}}}"',
+        f'DLCFN_GROUPS="${{DLCFN_GROUPS:-{shlex.quote(",".join(worker_group_names(spec.name, spec.pool.slices)))}}}"',
+        f'DLCFN_MIN_SLICES="${{DLCFN_MIN_SLICES:-{spec.pool.min_slices or ""}}}"',
         f'DLCFN_STORAGE_MOUNT="${{DLCFN_STORAGE_MOUNT:-{shlex.quote(spec.storage.mount_point)}}}"',
         f'DLCFN_BOOTSTRAP_BUDGET_S="${{DLCFN_BOOTSTRAP_BUDGET_S:-{spec.timeouts.bootstrap_budget_s:.0f}}}"',
         f'DLCFN_POLL_INTERVAL_S="${{DLCFN_POLL_INTERVAL_S:-{spec.timeouts.poll_interval_s:g}}}"',
-        "export DLCFN_WORKER_INDEX DLCFN_BROKER DLCFN_ROLE DLCFN_GROUPS "
-        "DLCFN_STORAGE_MOUNT DLCFN_BOOTSTRAP_BUDGET_S DLCFN_POLL_INTERVAL_S",
+        "export DLCFN_WORKER_INDEX DLCFN_BROKER DLCFN_ROLE DLCFN_SLICE "
+        "DLCFN_GROUPS DLCFN_MIN_SLICES DLCFN_STORAGE_MOUNT "
+        "DLCFN_BOOTSTRAP_BUDGET_S DLCFN_POLL_INTERVAL_S",
         "exec python3 -m deeplearning_cfn_tpu.cluster.agent_main",
     ]

@@ -121,8 +121,16 @@ class NodePool:
     """
 
     accelerator_type: str = "v5p-32"
-    workers: int | None = None  # derived from accelerator_type when None
+    workers: int | None = None  # PER-SLICE workers; derived when None
     min_workers: int | None = None  # None => must reach full size
+    # Multi-slice scale-out (SURVEY §7 hard part 5): ``slices`` identical
+    # TPU slices composed over DCN (parallel/mesh.py:build_hybrid_mesh is
+    # the compute-side pairing).  A slice is all-or-nothing in a way an
+    # ASG is not, so degrade-and-continue at this level means DROPPING a
+    # failed slice when at least ``min_slices`` remain — the TPU shape of
+    # lambda_function.py:142-169's shrink-the-ASG policy.
+    slices: int = 1
+    min_slices: int | None = None  # None => all slices required
     placement_policy: str = "compact"  # placement-group analog (mask-rcnn-cfn.yaml:313-316)
     runtime_version: str = "tpu-ubuntu2204-base"  # the AMI/ImageType analog
     image_override: str | None = None  # AMIOverride analog (mask-rcnn-cfn.yaml:155-160)
@@ -150,12 +158,23 @@ class NodePool:
             raise ConfigError(
                 f"min_workers must be in [1, {n}], got {self.min_workers}"
             )
+        if self.slices < 1:
+            raise ConfigError(f"slices must be >= 1, got {self.slices}")
+        if self.min_slices is not None and not (1 <= self.min_slices <= self.slices):
+            raise ConfigError(
+                f"min_slices must be in [1, {self.slices}], got {self.min_slices}"
+            )
 
     @property
     def num_workers(self) -> int:
+        """Workers per slice."""
         if self.workers is not None:
             return self.workers
         return accelerator_workers(self.accelerator_type)
+
+    @property
+    def total_workers(self) -> int:
+        return self.num_workers * self.slices
 
     @property
     def chips_per_worker(self) -> int:
@@ -163,7 +182,7 @@ class NodePool:
 
     @property
     def total_chips(self) -> int:
-        return self.num_workers * self.chips_per_worker
+        return self.total_workers * self.chips_per_worker
 
 
 @dataclass
@@ -286,9 +305,13 @@ class JobSpec:
                 f"global_batch_size {self.global_batch_size} not divisible by "
                 f"total chips {pool.total_chips}"
             )
-        if self.require_even_workers and pool.num_workers not in (1,) and pool.num_workers % 2:
+        if (
+            self.require_even_workers
+            and pool.total_workers not in (1,)
+            and pool.total_workers % 2
+        ):
             raise ConfigError(
-                f"worker count must be 1 or even, got {pool.num_workers}"
+                f"worker count must be 1 or even, got {pool.total_workers}"
             )
 
     def steps_per_epoch(self, pool: NodePool) -> int | None:

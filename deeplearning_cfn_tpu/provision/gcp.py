@@ -46,6 +46,14 @@ log = get_logger("dlcfn.gcp")
 Transport = Callable[[str, str, dict | None], dict]
 
 
+def _slice_ordinal(group_name: str) -> str:
+    """'cluster-workers-s3' -> '3'; single-slice names -> '0'."""
+    stem, sep, tail = group_name.rpartition("-s")
+    if sep and tail.isdigit():
+        return tail
+    return "0"
+
+
 class TransportUnavailable(RuntimeError):
     """No transport is wired (broker-only control plane).  State-object
     helpers catch exactly this and degrade to in-memory state; real API
@@ -216,6 +224,11 @@ class GCPBackend(Backend):
                                     # (deeplearning.template:490-516).
                                     "startup-script": self.startup_script
                                     or "python -m deeplearning_cfn_tpu.cluster.agent_main",
+                                    # Slice ordinal (multi-slice groups are
+                                    # named ...-s<i>): worker 0 of slice 0
+                                    # runs the coordinator role; every
+                                    # other slice's worker 0 must NOT.
+                                    "dlcfn-slice": _slice_ordinal(name),
                                     # Rendezvous address the startup script
                                     # reads back (attributes/dlcfn-broker);
                                     # without it agents have no control

@@ -47,6 +47,16 @@ def worker_group_name(cluster_name: str) -> str:
     return f"{cluster_name}-{WORKER_GROUP_SUFFIX}"
 
 
+def worker_group_names(cluster_name: str, slices: int) -> list[str]:
+    """One worker group per slice; the single-slice name is unchanged so
+    existing clusters/tests keep their identity."""
+    if slices <= 1:
+        return [worker_group_name(cluster_name)]
+    return [
+        f"{worker_group_name(cluster_name)}-s{i}" for i in range(slices)
+    ]
+
+
 @dataclass
 class ProvisionResult:
     spec: ClusterSpec
@@ -118,6 +128,10 @@ class Provisioner:
         return worker_group_name(self.spec.name)
 
     @property
+    def group_names(self) -> list[str]:
+        return worker_group_names(self.spec.name, self.spec.pool.slices)
+
+    @property
     def coordinator_queue_name(self) -> str:
         return f"{self.spec.name}-coordinator-queue"
 
@@ -140,7 +154,7 @@ class Provisioner:
             # behind before agents can read them.
             self.backend.reset_cluster_state(
                 spec.name,
-                [self.group_name],
+                self.group_names,
                 [
                     self.coordinator_queue_name,
                     self.worker_queue_name,
@@ -154,14 +168,15 @@ class Provisioner:
             backend=self.backend,
             coordinator_queue_name=self.coordinator_queue_name,
         )
-        controller.register(
-            GroupPolicy(
-                name=self.group_name,
-                minimum=pool.min_workers or pool.num_workers,
-                signal_resource=f"group:{self.group_name}",
-                coordinator=True,
+        for i, gname in enumerate(self.group_names):
+            controller.register(
+                GroupPolicy(
+                    name=gname,
+                    minimum=pool.min_workers or pool.num_workers,
+                    signal_resource=f"group:{gname}",
+                    coordinator=(i == 0),
+                )
             )
-        )
         controller.attach()
         self._controller = controller
 
@@ -182,14 +197,16 @@ class Provisioner:
         # by a fresh-process recover().
         self._record_storage()
 
-        # Creating the group fires INSTANCE_LAUNCH / INSTANCE_LAUNCH_ERROR
-        # events into the controller (the ASG -> SNS -> Lambda path).
-        self.backend.create_group(
-            self.group_name,
-            desired=pool.num_workers,
-            minimum=pool.min_workers or pool.num_workers,
-            chips_per_worker=pool.chips_per_worker,
-        )
+        # Creating the group(s) fires INSTANCE_LAUNCH / INSTANCE_LAUNCH_ERROR
+        # events into the controller (the ASG -> SNS -> Lambda path).  One
+        # group per slice: on GCP each is its own queued resource.
+        for gname in self.group_names:
+            self.backend.create_group(
+                gname,
+                desired=pool.num_workers,
+                minimum=pool.min_workers or pool.num_workers,
+                chips_per_worker=pool.chips_per_worker,
+            )
 
         if self.remote_agents:
             contract = self._await_remote_bootstrap(worker_q)
@@ -228,21 +245,29 @@ class Provisioner:
             if clock is not None
             else TimeoutBudget(spec.timeouts.bootstrap_budget_s)
         )
-        group = self.backend.describe_group(self.group_name)
-        candidates = group.healthy_instances  # includes PENDING; IPs resolved below
+        # Coordinator = lowest-index healthy instance of slice 0 (the
+        # coordinator slice is always required; its wholesale failure is a
+        # provisioning failure, matching the on-VM agent's policy).
+        group = self.backend.describe_group(self.group_names[0])
+        candidates = group.healthy_instances  # includes PENDING
         if not candidates:
-            raise ProvisionFailure("no healthy instances launched")
+            raise ProvisionFailure(
+                "no healthy instances launched in the coordinator slice"
+            )
         agent = BootstrapAgent(
             backend=self.backend,
             cluster_name=spec.name,
             coordinator_queue=coord_q,
             worker_queue=worker_q,
-            group_names=[self.group_name],
+            group_names=self.group_names,
             budget=budget,
             poll_interval_s=spec.timeouts.poll_interval_s,
             storage_mount=spec.storage.mount_point,
             contract_root=self.contract_root,
-            group_signal_resources={self.group_name: f"group:{self.group_name}"},
+            group_signal_resources={
+                g: f"group:{g}" for g in self.group_names
+            },
+            min_groups=spec.pool.min_slices,
         )
         # Worker 0 (lowest index healthy instance) runs the coordinator role.
         coordinator = min(candidates, key=lambda i: i.index)
@@ -272,7 +297,7 @@ class Provisioner:
                 cluster_name=spec.name,
                 coordinator_queue=coord_q,
                 worker_queue=worker_q,
-                group_names=[self.group_name],
+                group_names=self.group_names,
                 budget=budget,
                 poll_interval_s=spec.timeouts.poll_interval_s,
                 storage_mount=spec.storage.mount_point,
@@ -297,10 +322,12 @@ class Provisioner:
         spec = self.spec
         budget = TimeoutBudget(spec.timeouts.cluster_ready_s)
         resource = cluster_ready_resource(spec.name)
-        group_resource = f"group:{self.group_name}"
+        min_groups = spec.pool.min_slices or len(self.group_names)
         phase = "remote-bootstrap"
         while True:
-            group = self.backend.publish_group_state(self.group_name)
+            groups = [
+                self.backend.publish_group_state(g) for g in self.group_names
+            ]
             signal = self.backend.get_resource_signal(resource)
             if signal is ResourceSignal.SUCCESS:
                 break
@@ -308,24 +335,40 @@ class Provisioner:
                 raise ProvisionFailure(
                     f"cluster {spec.name!r} signaled FAILURE during bootstrap"
                 )
-            # Fail fast on a below-minimum group verdict: if no coordinator
-            # VM ever booted, nobody translates the group FAILURE into a
-            # cluster-ready FAILURE — the controller must read the verdict
-            # it already rendered instead of burning the whole budget.
-            if (
-                self.backend.get_resource_signal(group_resource)
+            # Fail fast when enough groups rendered a below-minimum verdict
+            # that the min_slices policy can no longer be met: if no
+            # coordinator VM ever booted, nobody translates group FAILUREs
+            # into a cluster-ready FAILURE — the controller must read the
+            # verdicts it already rendered instead of burning the budget.
+            failed = [
+                g
+                for g in self.group_names
+                if self.backend.get_resource_signal(f"group:{g}")
                 is ResourceSignal.FAILURE
+            ]
+            # The coordinator slice is always required (it hosts the
+            # bootstrap choreography — the master-ASG CreationPolicy
+            # analog); min_slices governs the rest.
+            if (
+                self.group_names[0] in failed
+                or len(self.group_names) - len(failed) < min_groups
             ):
                 self.backend.signal_resource(resource, ResourceSignal.FAILURE)
                 raise ProvisionFailure(
-                    f"group {self.group_name!r} failed to reach minimum capacity"
+                    f"group(s) {failed} failed to reach minimum capacity "
+                    f"({len(self.group_names) - len(failed)} surviving, "
+                    f"min {min_groups}, coordinator slice required)"
                 )
             if self.progress is not None:
                 running = sum(
-                    1 for i in group.healthy_instances if i.private_ip
+                    1
+                    for g in groups
+                    for i in g.healthy_instances
+                    if i.private_ip
                 )
+                desired = sum(g.desired for g in groups)
                 self.progress(
-                    budget.elapsed_s, f"{running}/{group.desired} workers up"
+                    budget.elapsed_s, f"{running}/{desired} workers up"
                 )
             try:
                 budget.sleep(spec.timeouts.poll_interval_s, phase)
@@ -365,12 +408,19 @@ class Provisioner:
         if expected <= 0:
             return
         ready_q = self.backend.get_queue(self.ready_queue_name)
-        seen: set[int] = set()
+        # Keyed by (group, index): per-slice worker indices restart at 0,
+        # so index alone under-counts on multi-slice clusters.
+        seen: set[tuple[str, int]] = set()
         phase = "worker-acks"
         while len(seen) < expected:
             for msg in ready_q.receive(max_messages=10, visibility_timeout_s=60.0):
                 if msg.body.get("event") == "worker-ready":
-                    seen.add(int(msg.body.get("index", -1)))
+                    seen.add(
+                        (
+                            str(msg.body.get("group", "")),
+                            int(msg.body.get("index", -1)),
+                        )
+                    )
                 ready_q.delete(msg.receipt)
             if len(seen) >= expected:
                 return
@@ -393,13 +443,13 @@ class Provisioner:
 
     # -- describe / delete (C11-equivalent operations) ---------------------
     def describe(self) -> dict[str, object]:
-        group = self.backend.describe_group(self.group_name)
-        return {
+        groups = [self.backend.describe_group(g) for g in self.group_names]
+        out: dict[str, object] = {
             "name": self.spec.name,
             "workers": {
-                "desired": group.desired,
-                "healthy": len(group.healthy_instances),
-                "frozen": group.replace_unhealthy_suspended,
+                "desired": sum(g.desired for g in groups),
+                "healthy": sum(len(g.healthy_instances) for g in groups),
+                "frozen": all(g.replace_unhealthy_suspended for g in groups),
             },
             "storage": self._storage.storage_id if self._storage else None,
             "ready": self.backend.get_resource_signal(
@@ -407,6 +457,15 @@ class Provisioner:
             )
             is ResourceSignal.SUCCESS,
         }
+        if len(groups) > 1:
+            out["slices"] = {
+                g.name: {
+                    "desired": g.desired,
+                    "healthy": len(g.healthy_instances),
+                }
+                for g in groups
+            }
+        return out
 
     def delete(self, force_storage: bool = False) -> dict[str, object]:
         if self._controller is not None:
@@ -414,7 +473,11 @@ class Provisioner:
             # later cluster with the same name (recover()).
             self._controller.detach()
             self._controller = None
-        self.backend.delete_group(self.group_name)
+        for gname in self.group_names:
+            try:
+                self.backend.delete_group(gname)
+            except KeyError:
+                pass  # never created (e.g. recover of a failed provision)
         storage_deleted = False
         if self._storage is not None:
             storage_deleted = self.backend.delete_storage(

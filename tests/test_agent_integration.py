@@ -145,6 +145,98 @@ def test_remote_bootstrap_end_to_end(broker, tmp_path):
     assert workers_files.pop().splitlines()[0] == "deeplearning-master"
 
 
+def test_multislice_remote_bootstrap(broker, tmp_path):
+    """Two slices x two workers over the production topology: 4 real
+    agent_main processes (each knowing only its slice ordinal + per-slice
+    worker index, like real TPU VMs), one controller process; the contract
+    must span both slices and the per-slice index collision must not
+    confuse the worker-ack count."""
+    cluster = "agentms"
+    template = {
+        "Cluster": {
+            "name": cluster,
+            "backend": "local",
+            "pool": {
+                "accelerator_type": "local-1",
+                "workers": 2,
+                "slices": 2,
+            },
+            "storage": {"kind": "local", "mount_point": "/mnt/dlcfn"},
+            "timeouts": {
+                "cluster_ready_s": 90.0,
+                "controller_launch_s": 30.0,
+                "poll_interval_s": 0.2,
+            },
+            "job": {"global_batch_size": 4},
+        }
+    }
+    tpl = tmp_path / "ms.json"
+    tpl.write_text(json.dumps(template))
+    groups = f"{cluster}-workers-s0,{cluster}-workers-s1"
+
+    vm_roots = []
+    agents = []
+    for slice_idx in range(2):
+        for widx in range(2):
+            root = tmp_path / f"msvm{slice_idx}{widx}"
+            vm_roots.append(root)
+            env = dict(os.environ)
+            env.update(
+                DLCFN_CLUSTER=cluster,
+                DLCFN_WORKER_INDEX=str(widx),
+                DLCFN_SLICE=str(slice_idx),
+                DLCFN_BROKER=f"127.0.0.1:{broker.port}",
+                DLCFN_GROUPS=groups,
+                DLCFN_STORAGE_MOUNT="/mnt/dlcfn",
+                DLCFN_BOOTSTRAP_BUDGET_S="90",
+                DLCFN_POLL_INTERVAL_S="0.2",
+                DLCFN_ROOT=str(root),
+            )
+            agents.append(
+                subprocess.Popen(
+                    [
+                        sys.executable,
+                        "-m",
+                        "deeplearning_cfn_tpu.cluster.agent_main",
+                    ],
+                    env=env,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            )
+
+    ctrl_root = tmp_path / "msctrl"
+    controller = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "deeplearning_cfn_tpu.cli",
+            "create",
+            str(tpl),
+            "--broker",
+            f"127.0.0.1:{broker.port}",
+        ],
+        env=dict(os.environ, DLCFN_ROOT=str(ctrl_root)),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    ctrl_out, ctrl_err = controller.communicate(timeout=120)
+    outputs = [proc.communicate(timeout=120)[0] for proc in agents]
+    assert controller.returncode == 0, f"controller failed:\n{ctrl_out}\n{ctrl_err}"
+    for i, proc in enumerate(agents):
+        assert proc.returncode == 0, f"agent {i} failed:\n{outputs[i]}"
+    summary = json.loads(ctrl_out)
+    assert summary["workers"] == 4
+    contracts = [
+        json.loads((root / "contract.json").read_text())
+        for root in [ctrl_root, *vm_roots]
+    ]
+    assert all(c == contracts[0] for c in contracts[1:])
+    assert len(contracts[0]["worker_ips"]) == 4
+
+
 def test_degraded_remote_bootstrap(broker, tmp_path):
     """Degrade-and-continue over the production topology: one injected
     launch failure, min_workers=2 -> the cluster comes up at 2 workers and

@@ -84,3 +84,16 @@ def test_recover(template_file, capsys, contract_root):
     out = json.loads(capsys.readouterr().out)
     assert out["workers"] >= 1
     assert "resume_hint" in out
+
+
+def test_run_auto_recover_no_loss(template_file, capsys, contract_root):
+    """dlcfn run --auto-recover N: with no instance loss the job runs
+    once and reports zero recoveries (the loss-triggered path is covered
+    by tests/test_recovery.py)."""
+    assert (
+        main(["run", template_file, "--auto-recover", "1", "-P", "Workers=2"])
+        == 0
+    )
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["recoveries"] == 0
+    assert out["result"]["steps"] > 0

@@ -1,0 +1,126 @@
+"""Multi-slice provisioning (SURVEY §7 hard part 5): N identical slices
+composed as N worker groups, with degrade-and-continue at SLICE
+granularity — a TPU slice fails whole, so the policy is drop-the-slice
+when at least min_slices remain, not shrink-the-group.  The compute-side
+pairing is parallel/mesh.py:build_hybrid_mesh (ICI within a slice, DCN
+across)."""
+
+import pytest
+
+from deeplearning_cfn_tpu.config.schema import (
+    ClusterSpec,
+    ConfigError,
+    JobSpec,
+    NodePool,
+    StorageSpec,
+    TimeoutSpec,
+)
+from deeplearning_cfn_tpu.provision.local import LocalBackend
+from deeplearning_cfn_tpu.provision.provisioner import (
+    ProvisionFailure,
+    Provisioner,
+    worker_group_names,
+)
+from deeplearning_cfn_tpu.utils.timeouts import FakeClock
+
+
+def make_spec(slices=2, workers=2, min_slices=None, batch=None):
+    return ClusterSpec(
+        name="ms-test",
+        backend="local",
+        pool=NodePool(
+            accelerator_type="local-1",
+            workers=workers,
+            slices=slices,
+            min_slices=min_slices,
+        ),
+        storage=StorageSpec(kind="local"),
+        timeouts=TimeoutSpec(cluster_ready_s=3300.0, controller_launch_s=600.0),
+        job=JobSpec(global_batch_size=batch or slices * workers * 8),
+    )
+
+
+def test_group_naming():
+    assert worker_group_names("c", 1) == ["c-workers"]
+    assert worker_group_names("c", 3) == [
+        "c-workers-s0",
+        "c-workers-s1",
+        "c-workers-s2",
+    ]
+
+
+def test_schema_validation():
+    with pytest.raises(ConfigError, match="slices must be >= 1"):
+        make_spec(slices=0).validate()
+    with pytest.raises(ConfigError, match="min_slices must be in"):
+        make_spec(slices=2, min_slices=3).validate()
+    pool = make_spec(slices=3, workers=2).pool
+    assert pool.total_workers == 6
+    assert pool.total_chips == 6
+
+
+def test_two_slices_provision_full(contract_root):
+    backend = LocalBackend(clock=FakeClock())
+    result = Provisioner(
+        backend, make_spec(slices=2, workers=2), contract_root=contract_root
+    ).provision()
+    assert not result.degraded
+    # 2 slices x 2 workers: one contract spanning both.
+    assert result.contract.workers_count == 4
+    # Both slice groups frozen after discovery.
+    for g in worker_group_names("ms-test", 2):
+        assert backend.describe_group(g).replace_unhealthy_suspended
+    desc = Provisioner(backend, make_spec(slices=2, workers=2)).describe()
+    assert desc["workers"]["desired"] == 4
+    assert set(desc["slices"]) == set(worker_group_names("ms-test", 2))
+
+
+def test_failed_slice_dropped_with_min_slices(contract_root):
+    # Slice s1's instances all fail at launch; min_slices=1 => proceed on
+    # slice s0 alone, marked degraded.
+    backend = LocalBackend(
+        clock=FakeClock(),
+        fail_instance_indices={"ms-test-workers-s1": {0, 1}},
+    )
+    spec = make_spec(slices=2, workers=2, min_slices=1, batch=16)
+    result = Provisioner(backend, spec, contract_root=contract_root).provision()
+    assert result.degraded
+    assert result.contract.workers_count == 2  # only slice s0
+    # The surviving slice hosts the coordinator.
+    assert result.contract.coordinator_ip in result.contract.worker_ips
+
+
+def test_failed_slice_without_min_slices_fails(contract_root):
+    backend = LocalBackend(
+        clock=FakeClock(),
+        fail_instance_indices={"ms-test-workers-s1": {0, 1}},
+    )
+    spec = make_spec(slices=2, workers=2, batch=16)  # min_slices=None: all required
+    with pytest.raises(ProvisionFailure):
+        Provisioner(backend, spec, contract_root=contract_root).provision()
+
+
+def test_coordinator_slice_failure_fails_provisioning(contract_root):
+    # Slice s0 hosts the coordinator; its wholesale failure fails the
+    # cluster even under min_slices — the master-ASG CreationPolicy
+    # asymmetry (deeplearning.template:669-674): worker capacity
+    # degrades, the control-plane host does not.
+    backend = LocalBackend(
+        clock=FakeClock(),
+        fail_instance_indices={"ms-test-workers-s0": {0, 1}},
+    )
+    spec = make_spec(slices=2, workers=2, min_slices=1, batch=16)
+    with pytest.raises(ProvisionFailure):
+        Provisioner(backend, spec, contract_root=contract_root).provision()
+
+
+def test_delete_removes_all_slices(contract_root):
+    backend = LocalBackend(clock=FakeClock())
+    prov = Provisioner(
+        backend, make_spec(slices=2, workers=2), contract_root=contract_root
+    )
+    prov.provision()
+    prov.delete()
+    for g in worker_group_names("ms-test", 2):
+        with pytest.raises(KeyError):
+            backend.describe_group(g)
