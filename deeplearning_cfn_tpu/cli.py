@@ -249,13 +249,28 @@ def _hypothetical_contract(spec: ClusterSpec):
     plans/scripts without a live cluster."""
     from deeplearning_cfn_tpu.cluster.contract import ClusterContract
 
+    from deeplearning_cfn_tpu.provision.provisioner import worker_group_names
+
     ips = [f"10.0.0.{i + 2}" for i in range(spec.pool.total_workers)]
+    per_slice = spec.pool.num_workers
+    groups = worker_group_names(spec.name, spec.pool.slices)
     return ClusterContract.build(
         cluster_name=spec.name,
         coordinator_ip=ips[0],
         other_worker_ips=ips[1:],
         chips_per_worker=spec.pool.chips_per_worker,
         storage_mount=spec.storage.mount_point,
+        # Placeholder slice topology so a multi-slice plan renders the
+        # same DEEPLEARNING_SLICES_COUNT (and thus mesh) the live
+        # contract will.
+        slices=(
+            {
+                g: ips[i * per_slice : (i + 1) * per_slice]
+                for i, g in enumerate(groups)
+            }
+            if spec.pool.slices > 1
+            else None
+        ),
     )
 
 

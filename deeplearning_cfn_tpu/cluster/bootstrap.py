@@ -266,6 +266,13 @@ class BootstrapAgent:
             chips_per_worker=chips,
             storage_mount=self.storage_mount,
             degraded=degraded,
+            # Slice topology (multi-slice only): lets compute derive the
+            # hybrid ICI x DCN mesh from the contract alone.
+            slices=(
+                {name: ips_by_group[name] for name in surviving}
+                if len(self.group_names) > 1
+                else None
+            ),
         )
         self._publish_contract(contract)
         self.worker_queue.send(contract.to_message())

@@ -41,6 +41,11 @@ class ClusterContract:
     coordinator_port: int = DEFAULT_COORDINATOR_PORT
     degraded: bool = False
     tags: dict[str, str] = field(default_factory=dict)
+    # Multi-slice topology: group name -> that slice's worker IPs, in
+    # slice order (None/absent = single slice).  Lets compute build the
+    # hybrid ICI x DCN mesh from the contract alone
+    # (parallel/mesh.py:hybrid_mesh_for_slices).
+    slices: dict[str, list[str]] | None = None
 
     @classmethod
     def build(
@@ -51,6 +56,7 @@ class ClusterContract:
         chips_per_worker: int,
         storage_mount: str,
         degraded: bool = False,
+        slices: dict[str, list[str]] | None = None,
     ) -> "ClusterContract":
         # Coordinator doubles as worker 0 (StackSetup.md:110-111); its IP is
         # prepended and the rest sorted for a stable order (dl_cfn_setup_v2.py:330-342).
@@ -62,12 +68,17 @@ class ClusterContract:
             chips_per_worker=chips_per_worker,
             storage_mount=storage_mount,
             degraded=degraded,
+            slices=slices,
         )
 
     # --- derived views ----------------------------------------------------
     @property
     def workers_count(self) -> int:
         return len(self.worker_ips)
+
+    @property
+    def slices_count(self) -> int:
+        return len(self.slices) if self.slices else 1
 
     @property
     def total_chips(self) -> int:
@@ -99,6 +110,7 @@ class ClusterContract:
             "DEEPLEARNING_COORDINATOR": f"{self.coordinator_ip}:{self.coordinator_port}",
             "DEEPLEARNING_CLUSTER_NAME": self.cluster_name,
             "DEEPLEARNING_DEGRADED": "1" if self.degraded else "0",
+            "DEEPLEARNING_SLICES_COUNT": str(self.slices_count),
         }
 
     def jax_initialize_kwargs(self, process_id: int) -> dict[str, object]:
@@ -153,6 +165,7 @@ class ClusterContract:
             "cluster": self.cluster_name,
             "coordinator-port": self.coordinator_port,
             "tags": self.tags,
+            "slices": self.slices,
         }
 
     @classmethod
@@ -166,4 +179,5 @@ class ClusterContract:
             degraded=bool(body.get("degraded", False)),
             coordinator_port=int(body.get("coordinator-port", DEFAULT_COORDINATOR_PORT)),  # type: ignore[arg-type]
             tags=dict(body.get("tags", {})),  # type: ignore[arg-type]
+            slices=body.get("slices"),  # type: ignore[arg-type]
         )

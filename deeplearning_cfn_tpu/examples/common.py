@@ -31,7 +31,26 @@ def maybe_init_distributed() -> int:
 
 
 def default_mesh(strategy: str = "dp"):
+    """Default training mesh; on a multi-slice cluster (the discovery
+    contract exports DEEPLEARNING_SLICES_COUNT) the data axis is split
+    hybrid: ICI within each slice, DCN across — gradient reduction is the
+    only cross-slice traffic, the layout build_hybrid_mesh exists for."""
     n = len(jax.devices())
+    n_slices = int(os.environ.get("DEEPLEARNING_SLICES_COUNT", "1") or "1")
+    if n_slices > 1:
+        # No silent flat fallback: a non-divisible device count is a
+        # misconfiguration, and quietly spanning fsdp across DCN would be
+        # a per-layer-all-gather-over-DCN perf disaster.  Let the helper
+        # raise its clear MeshError instead.
+        from deeplearning_cfn_tpu.parallel.mesh import hybrid_mesh_for_slices
+
+        per_slice = n // n_slices
+        ici = (
+            MeshSpec.fsdp_parallel(per_slice)
+            if strategy == "fsdp"
+            else MeshSpec.data_parallel(per_slice)
+        )
+        return hybrid_mesh_for_slices(n_slices, ici_spec=ici, dcn_axis="dp")
     spec = MeshSpec.fsdp_parallel(n) if strategy == "fsdp" else MeshSpec.data_parallel(n)
     return build_mesh(spec)
 

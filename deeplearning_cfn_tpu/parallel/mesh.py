@@ -118,6 +118,13 @@ class AutoLayout:
         return MeshSpec(fsdp=self.n_devices // tp, tp=tp)
 
 
+def _granule_of(d, has_slice: bool):
+    """A device's DCN granule: its slice when the platform exposes one,
+    else its host process.  (Separate function so tests can exercise the
+    multi-granule grouping on virtual CPU devices.)"""
+    return d.slice_index if has_slice else getattr(d, "process_index", 0)
+
+
 def build_hybrid_mesh(
     ici_spec: MeshSpec, dcn_spec: MeshSpec, devices: list | None = None
 ) -> Mesh:
@@ -163,11 +170,13 @@ def build_hybrid_mesh(
     # Granule = what create_hybrid_device_mesh will group by: slice_index
     # when the platform exposes it, else whole processes.
     has_slice = all(hasattr(d, "slice_index") for d in devices)
-    granules = {
-        d.slice_index if has_slice else getattr(d, "process_index", 0)
-        for d in devices
-    }
-    if len(granules) > 1:
+    granules = {_granule_of(d, has_slice) for d in devices}
+    # create_hybrid_device_mesh requires #granules == prod(dcn shape); with
+    # process granules and multiple hosts per slice that doesn't hold
+    # (2 slices x 2 hosts = 4 process granules, dcn product 2) — group
+    # consecutive granules via the deterministic reshape instead.
+    dcn_product = int(np.prod(dcn_shape))
+    if len(granules) > 1 and len(granules) == dcn_product:
         from jax.experimental import mesh_utils
 
         grid = mesh_utils.create_hybrid_device_mesh(
@@ -175,6 +184,18 @@ def build_hybrid_mesh(
             process_is_granule=not has_slice,
             allow_split_physical_axes=True,
         )
+    elif len(granules) > 1:
+        # Sort so each granule's devices are contiguous, then reshape:
+        # consecutive granule blocks form the DCN axes (valid when slice
+        # membership follows process order, which provisioning guarantees).
+        devices = sorted(devices, key=lambda d: (_granule_of(d, has_slice), d.id))
+        n_axes = len(AXIS_ORDER)
+        grid = np.array(devices).reshape(*dcn_shape, *ici_shape)
+        order = [i + off for i in range(n_axes) for off in (0, n_axes)]
+        grid = grid.transpose(order).reshape(
+            *(d * i for d, i in zip(dcn_shape, ici_shape))
+        )
+        return Mesh(grid, axis_names=tuple(AXIS_ORDER))
     else:
         # Single granule: [dcn axes..., ici axes...] then interleave per
         # axis so each combined axis is (dcn, ici) with dcn slowest.
@@ -205,3 +226,32 @@ def virtual_cpu_devices(n: int) -> list:
 
 def largest_pow2_dp(n_devices: int) -> int:
     return 1 << int(math.log2(max(n_devices, 1)))
+
+
+def hybrid_mesh_for_slices(
+    n_slices: int,
+    ici_spec: MeshSpec | None = None,
+    dcn_axis: str = "dp",
+    devices: list | None = None,
+) -> Mesh:
+    """Mesh for an ``n_slices`` cluster straight from the contract's
+    topology (ClusterContract.slices / DEEPLEARNING_SLICES_COUNT): ICI
+    axes within each slice (default: data-parallel over the per-slice
+    devices), one DCN axis of size n_slices across them.  The glue that
+    turns multi-slice *provisioning* into a multi-slice *program* without
+    the trainer knowing either side's details."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_slices <= 1:
+        return build_mesh(
+            ici_spec or MeshSpec.data_parallel(len(devices)), devices
+        )
+    if len(devices) % n_slices:
+        raise MeshError(
+            f"{len(devices)} devices do not divide into {n_slices} slices"
+        )
+    per_slice = len(devices) // n_slices
+    ici = ici_spec or MeshSpec.data_parallel(per_slice)
+    if dcn_axis not in AXIS_ORDER:
+        raise MeshError(f"unknown dcn axis {dcn_axis!r}")
+    dcn = MeshSpec(**{dcn_axis: n_slices})
+    return build_hybrid_mesh(ici, dcn, devices)

@@ -124,3 +124,73 @@ def test_delete_removes_all_slices(contract_root):
     for g in worker_group_names("ms-test", 2):
         with pytest.raises(KeyError):
             backend.describe_group(g)
+
+
+def test_contract_carries_slice_topology(contract_root):
+    backend = LocalBackend(clock=FakeClock())
+    result = Provisioner(
+        backend, make_spec(slices=2, workers=2), contract_root=contract_root
+    ).provision()
+    contract = result.contract
+    assert contract.slices_count == 2
+    assert set(contract.slices) == set(worker_group_names("ms-test", 2))
+    assert sum(len(v) for v in contract.slices.values()) == 4
+    # Round-trips through the file and the broadcast message.
+    from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+
+    assert ClusterContract.read(contract_root) == contract
+    assert ClusterContract.from_message(contract.to_message()) == contract
+    # And into the env contract trainers read.
+    assert contract.env(contract_root)["DEEPLEARNING_SLICES_COUNT"] == "2"
+
+
+def test_hybrid_mesh_for_slices():
+    import jax
+
+    from deeplearning_cfn_tpu.parallel.mesh import (
+        MeshError,
+        MeshSpec,
+        hybrid_mesh_for_slices,
+    )
+
+    mesh = hybrid_mesh_for_slices(2, devices=jax.devices()[:8])
+    assert mesh.shape["dp"] == 8  # 2 slices (dcn) x 4 per slice (ici)
+    mesh = hybrid_mesh_for_slices(
+        2, ici_spec=MeshSpec.fsdp_parallel(4), devices=jax.devices()[:8]
+    )
+    assert mesh.shape["dp"] == 2 and mesh.shape["fsdp"] == 4
+    with pytest.raises(MeshError, match="do not divide"):
+        hybrid_mesh_for_slices(3, devices=jax.devices()[:8])
+
+
+def test_default_mesh_uses_slice_topology(monkeypatch):
+    from deeplearning_cfn_tpu.examples.common import default_mesh
+
+    monkeypatch.setenv("DEEPLEARNING_SLICES_COUNT", "2")
+    mesh = default_mesh("fsdp")
+    assert mesh.shape["dp"] == 2 and mesh.shape["fsdp"] == 4
+
+
+def test_hybrid_mesh_multihost_granules(monkeypatch):
+    """2 slices x 2 hosts/slice (4 process granules, DCN product 2):
+    create_hybrid_device_mesh would reject granules != dcn product, so
+    build_hybrid_mesh must group consecutive granules via the
+    deterministic reshape instead of crashing every multi-host-per-slice
+    cluster without slice_index metadata."""
+    import jax
+
+    from deeplearning_cfn_tpu.parallel import mesh as mesh_mod
+
+    # 8 CPU devices as 4 fake host processes of 2 devices each.
+    monkeypatch.setattr(
+        mesh_mod, "_granule_of", lambda d, has_slice: d.id // 2
+    )
+    m = mesh_mod.build_hybrid_mesh(
+        mesh_mod.MeshSpec.data_parallel(4),
+        mesh_mod.MeshSpec(dp=2),
+        jax.devices()[:8],
+    )
+    assert m.shape["dp"] == 8
+    # Slice 0 (granules 0-1 = devices 0-3) occupies the first DCN block.
+    first_block = [d.id for d in m.devices.flatten()[:4]]
+    assert sorted(first_block) == [0, 1, 2, 3]
