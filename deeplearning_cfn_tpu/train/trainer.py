@@ -382,23 +382,29 @@ class Trainer:
         example-weighted mean metrics (plus ``examples`` seen).  The held-
         out counterpart of the reference's train-accuracy walkthrough
         metric (README.md:141)."""
-        totals: dict[str, float] = {}
-        examples = 0
         eval_fn = self.eval_step
         # islice, not enumerate+break: break would pull (and discard) one
         # batch past the limit from the caller's iterator.
         if steps is not None:
             batches = itertools.islice(batches, steps)
+        # Device scalars accumulate host-side and materialize in ONE
+        # readback at the end — a per-batch float() would serialize the
+        # eval loop on device round-trips just like the old fit() did.
+        per_batch: list[tuple[int, dict]] = []
         for batch in batches:
             x, y = device_put_batch(batch, self.batch_sharding)
             with jax.set_mesh(self.mesh):
                 metrics = eval_fn(state, x, y)
-            n = len(batch.x)
-            examples += n
-            for k, v in metrics.items():
-                totals[k] = totals.get(k, 0.0) + float(v) * n
+            per_batch.append((len(batch.x), metrics))
+        counts = [n for n, _ in per_batch]
+        examples = sum(counts)
         if examples == 0:
             return {"examples": 0}
+        materialized = jax.device_get([m for _, m in per_batch])
+        totals: dict[str, float] = {}
+        for n, metrics in zip(counts, materialized):
+            for k, v in metrics.items():
+                totals[k] = totals.get(k, 0.0) + float(v) * n
         out = {k: v / examples for k, v in totals.items()}
         out["examples"] = examples
         return out
