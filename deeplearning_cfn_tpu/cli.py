@@ -384,7 +384,15 @@ def cmd_convert(args) -> int:
     from deeplearning_cfn_tpu.train import datasets
 
     try:
-        if args.format == "imagefolder":
+        if args.format == "text":
+            out = datasets.convert_text(
+                args.src,
+                args.out,
+                seq_len=args.seq_len,
+                tokenizer_dir=args.tokenizer,
+                split=args.split,
+            )
+        elif args.format == "imagefolder":
             out = datasets.convert_imagefolder(
                 args.src, args.out, size=args.size, split=args.split
             )
@@ -546,7 +554,7 @@ def main(argv: list[str] | None = None) -> int:
     # convert has no template: it maps a public dataset layout to DLC1.
     pc = sub.add_parser("convert", help="dataset -> DLC1 records")
     pc.add_argument("--format", required=True,
-                    choices=["cifar10", "mnist", "imagefolder", "coco"])
+                    choices=["cifar10", "mnist", "imagefolder", "coco", "text"])
     pc.add_argument("--src", required=True, help="dataset source dir")
     pc.add_argument("--out", required=True, help="output dir for .dlc files")
     pc.add_argument("--size", type=int, default=224,
@@ -556,6 +564,11 @@ def main(argv: list[str] | None = None) -> int:
     pc.add_argument("--annotations", default=None,
                     help="COCO instances_*.json path")
     pc.add_argument("--max-boxes", type=int, default=50, dest="max_boxes")
+    pc.add_argument("--seq-len", type=int, default=2048, dest="seq_len",
+                    help="token window length for --format text")
+    pc.add_argument("--tokenizer", default=None,
+                    help="local HF tokenizer dir for --format text "
+                         "(default: byte-level)")
     pc.set_defaults(fn=cmd_convert)
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -127,6 +127,31 @@ def metrics_sink(args, run_name: str):
     return JsonlMetricsSink.for_run(args.metrics_dir, run_name)
 
 
+def record_paths(data_dir: str, eval_mode: bool = False):
+    """Resolve --data_dir to (root, DLC1 paths): probe the candidate dirs
+    in order (run.sh:21-35), then select the split — eval reads the
+    test/val/heldout files when staged, training excludes them.  Shared by
+    every record-consuming example so split policy cannot diverge."""
+    from pathlib import Path
+
+    from deeplearning_cfn_tpu.train.data import probe_data_source
+
+    root = probe_data_source(data_dir.split(":"))
+    if root is None:
+        raise SystemExit(f"--data_dir: none of {data_dir!r} exists")
+    paths = sorted(Path(root).glob("*.dlc"))
+    if not paths:
+        raise SystemExit(f"--data_dir: no .dlc record files under {root}")
+    heldout_stems = ("test", "val", "heldout")
+    if eval_mode:
+        evals = [p for p in paths if p.stem in heldout_stems]
+        paths = evals or paths
+    elif len(paths) > 1:
+        trains = [p for p in paths if p.stem not in heldout_stems]
+        paths = trains or paths
+    return root, paths
+
+
 def image_pipeline(args, image_shape, fallback_ds, eval_mode: bool = False):
     """(batches_fn, input_stats) for an image trainer: DLC1 records
     through the native loader when ``--data_dir`` is set (first existing
@@ -153,26 +178,11 @@ def image_pipeline(args, image_shape, fallback_ds, eval_mode: bool = False):
     """
     if not args.data_dir:
         return fallback_ds.batches, None
-    from pathlib import Path
-
-    from deeplearning_cfn_tpu.train.data import probe_data_source
     from deeplearning_cfn_tpu.train.datasets import STATS, read_stats_sidecar
     from deeplearning_cfn_tpu.train.native_loader import NativeRecordLoader
     from deeplearning_cfn_tpu.train.records import RecordSpec, read_header
 
-    root = probe_data_source(args.data_dir.split(":"))
-    if root is None:
-        raise SystemExit(f"--data_dir: none of {args.data_dir!r} exists")
-    paths = sorted(Path(root).glob("*.dlc"))
-    if not paths:
-        raise SystemExit(f"--data_dir: no .dlc record files under {root}")
-    if eval_mode:
-        # Held-out scoring reads the test/val split when present.
-        evals = [p for p in paths if p.stem in ("test", "val", "heldout")]
-        paths = evals or paths
-    elif len(paths) > 1:
-        trains = [p for p in paths if p.stem not in ("test", "val", "heldout")]
-        paths = trains or paths
+    root, paths = record_paths(args.data_dir, eval_mode)
     batch = args.global_batch_size or fallback_ds.batch_size
     # Records may be float32 (synthetic staging) or uint8 (real-dataset
     # converters, train/datasets.py); the file header disambiguates.

@@ -23,6 +23,45 @@ from deeplearning_cfn_tpu.train.metrics import ThroughputLogger
 from deeplearning_cfn_tpu.train.trainer import TrainerConfig
 
 
+def token_record_batches(args, cfg, batch: int):
+    """Token DLC1 records (``dlcfn convert --format text``) when
+    --data_dir is set; None = synthetic.  The tokenizer sidecar's
+    vocabulary must fit the model's embedding table, and record windows
+    must match --seq_len."""
+    if not args.data_dir:
+        return None
+    from deeplearning_cfn_tpu.examples.common import record_paths
+    from deeplearning_cfn_tpu.train.datasets import (
+        read_tokenizer_sidecar,
+        token_batches,
+        token_spec,
+    )
+    from deeplearning_cfn_tpu.train.native_loader import NativeRecordLoader
+
+    root, paths = record_paths(args.data_dir)
+    sidecar = read_tokenizer_sidecar(root)
+    if sidecar and int(sidecar.get("vocab_size", 0)) > cfg.vocab_size:
+        raise SystemExit(
+            f"records were tokenized with vocab_size={sidecar['vocab_size']} "
+            f"but the model's vocab is {cfg.vocab_size}; pick a matching "
+            "--size/config or reconvert with the model's tokenizer"
+        )
+    rec_seq = int(sidecar.get("seq_len", args.seq_len)) if sidecar else args.seq_len
+    if rec_seq != args.seq_len:
+        raise SystemExit(
+            f"records hold {rec_seq}-token windows but --seq_len is "
+            f"{args.seq_len}; pass --seq_len {rec_seq}"
+        )
+    spec = token_spec(rec_seq)
+    loader = NativeRecordLoader(
+        paths,
+        spec,
+        batch_size=batch,
+        n_threads=1 if jax.process_count() > 1 else 4,
+    )
+    return lambda steps: token_batches(loader, spec, steps)
+
+
 def main(argv: list[str] | None = None) -> dict:
     from deeplearning_cfn_tpu.examples.common import first_step_clock
 
@@ -80,7 +119,8 @@ def main(argv: list[str] | None = None) -> dict:
     ds = SyntheticTokenDataset(
         seq_len=args.seq_len, vocab_size=cfg.vocab_size, batch_size=batch
     )
-    sample = next(iter(ds.batches(1)))
+    batches = token_record_batches(args, cfg, batch) or ds.batches
+    sample = next(iter(batches(1)))
     state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
     ckpt = None
     if args.checkpoint_dir:
@@ -93,7 +133,7 @@ def main(argv: list[str] | None = None) -> dict:
         global_batch_size=batch * args.seq_len, log_every=args.log_every, name="llama", sink=_sink
     )
     state, losses = trainer.fit(
-        state, ds.batches(args.steps), steps=args.steps, logger=logger, checkpointer=ckpt
+        state, batches(args.steps), steps=args.steps, logger=logger, checkpointer=ckpt
     )
     if ckpt:
         ckpt.save(int(state.step), state)

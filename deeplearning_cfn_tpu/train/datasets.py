@@ -422,6 +422,129 @@ def detection_batches(
         i += 1
 
 
+# --- text -> token records (causal LM) ---------------------------------------
+
+
+def token_spec(seq_len: int) -> RecordSpec:
+    """One fixed-length token window per record; the trainer derives the
+    next-token targets by shifting, so only inputs are stored."""
+    return RecordSpec((Field("x", "int32", (seq_len,)),))
+
+
+def convert_text(
+    src: str | Path,
+    out_dir: str | Path,
+    seq_len: int = 2048,
+    tokenizer_dir: str | None = None,
+    split: str = "train",
+    stride: int | None = None,
+) -> dict:
+    """Plain-text file(s) -> fixed-window DLC1 token records for the
+    causal-LM trainers (the LM counterpart of the image converters).
+
+    ``tokenizer_dir``: a local HuggingFace tokenizer directory
+    (tokenizer.json etc., loaded offline via AutoTokenizer) — the
+    vocabulary the checkpoint being trained/fine-tuned expects.  Without
+    one, a byte-level vocabulary (256 + BOS) is used: self-contained and
+    reversible, fine for from-scratch small models.  The choice is pinned
+    in ``tokenizer.json`` metadata next to the records.
+    """
+    src = Path(src)
+    out_dir = Path(out_dir)
+    files = sorted(src.glob("*.txt")) if src.is_dir() else [src]
+    if not files:
+        raise DatasetFormatError(f"no .txt files under {src}")
+    stride = stride or seq_len
+
+    if tokenizer_dir:
+        from transformers import AutoTokenizer  # local dir, offline
+
+        tok = AutoTokenizer.from_pretrained(tokenizer_dir)
+
+        def token_stream(path: Path):
+            # Whole-file encode: chunking would change tokenization at
+            # chunk boundaries for subword vocabularies.
+            yield tok.encode(path.read_text(errors="replace"))
+
+        # len(tok), not tok.vocab_size: added/special tokens emit ids
+        # beyond the base vocabulary, and the trainer's embedding-bounds
+        # check must see the true ceiling.
+        vocab_size = len(tok)
+        tokenizer_name = str(tokenizer_dir)
+    else:
+        BOS = 256
+
+        def token_stream(path: Path):
+            # Byte-level tokenization is boundary-free: stream the file
+            # in chunks instead of materializing it.
+            yield [BOS]
+            with open(path, "rb") as f:
+                while chunk := f.read(1 << 20):
+                    yield list(chunk)
+
+        vocab_size = 257
+        tokenizer_name = "byte-level"
+
+    spec = token_spec(seq_len)
+
+    def gen():
+        buf: list[int] = []
+        off = 0
+        for path in files:
+            for chunk in token_stream(path):
+                buf.extend(chunk)
+                while len(buf) - off >= seq_len:
+                    window = np.asarray(buf[off : off + seq_len], np.int32)
+                    yield spec.encode(x=window)
+                    off += stride
+                # Amortized O(T): drop consumed tokens once per chunk,
+                # not once per window (buf = buf[stride:] per window is
+                # quadratic on large files).
+                if off:
+                    del buf[:off]
+                    off = 0
+
+    n = write_records(out_dir / f"{split}.dlc", spec, gen())
+    (out_dir / "tokenizer.json").write_text(
+        json.dumps(
+            {
+                "tokenizer": tokenizer_name,
+                "vocab_size": vocab_size,
+                "seq_len": seq_len,
+            }
+        )
+    )
+    log.info("text %s: %d windows of %d tokens -> %s", split, n, seq_len, out_dir)
+    return {
+        "spec": f"tokens{seq_len}",
+        "out_dir": str(out_dir),
+        "records": {split: n},
+        "vocab_size": vocab_size,
+        "tokenizer": tokenizer_name,
+    }
+
+
+def token_batches(loader, spec: RecordSpec, steps: int | None = None):
+    """Decode token records into causal-LM Batches: targets are the
+    inputs shifted left (the SyntheticTokenDataset convention; the loss
+    masks the wrapped final position)."""
+    i = 0
+    while steps is None or i < steps:
+        raw = loader.next_raw(copy=False)
+        if raw is None:
+            return
+        tokens = spec.decode_batch(raw)["x"]
+        yield Batch(x=tokens, y=np.roll(tokens, -1, axis=1))
+        i += 1
+
+
+def read_tokenizer_sidecar(root: str | Path) -> dict | None:
+    try:
+        return json.loads((Path(root) / "tokenizer.json").read_text())
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
 # --- dispatch ----------------------------------------------------------------
 
 CONVERTERS = {

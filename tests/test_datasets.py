@@ -343,3 +343,86 @@ def test_cli_convert_command(tmp_path, capsys):
     assert rc == 0
     out = json.loads(capsys.readouterr().out)
     assert out["records"] == {"train": 64}
+
+
+def test_text_to_token_records_byte_level(tmp_path):
+    src = tmp_path / "corpus"
+    src.mkdir()
+    (src / "a.txt").write_text("hello world, " * 50)
+    (src / "b.txt").write_text("the quick brown fox. " * 50)
+    out = datasets.convert_text(src, tmp_path / "dlc", seq_len=64)
+    assert out["tokenizer"] == "byte-level"
+    assert out["vocab_size"] == 257
+    assert out["records"]["train"] > 10
+    spec = datasets.token_spec(64)
+    decoded = read_all(tmp_path / "dlc" / "train.dlc", spec)
+    assert decoded["x"].shape[1] == 64
+    assert decoded["x"].dtype == np.int32
+    assert decoded["x"].max() <= 256
+    # First window starts with BOS then the first file's bytes.
+    assert decoded["x"][0][0] == 256
+    assert bytes(decoded["x"][0][1:13].astype(np.uint8)).decode() == "hello world,"
+    sidecar = json.loads((tmp_path / "dlc" / "tokenizer.json").read_text())
+    assert sidecar["seq_len"] == 64
+
+
+def test_llama_trains_on_text_records(tmp_path):
+    """convert --format text -> native loader -> Llama causal-LM training:
+    the LM counterpart of the cifar convert->train path."""
+    import jax
+
+    from deeplearning_cfn_tpu.examples.llama_train import main
+
+    src = tmp_path / "corpus"
+    src.mkdir()
+    (src / "a.txt").write_text("abcdefgh " * 400)
+    datasets.convert_text(src, tmp_path / "dlc", seq_len=32)
+    out = main(
+        [
+            "--size", "tiny",
+            "--seq_len", "32",
+            "--steps", "3",
+            "--global_batch_size", "8",
+            "--data_dir", str(tmp_path / "dlc"),
+        ]
+    )
+    assert np.isfinite(out["final_loss"])
+    assert out["steps"] == 3
+
+
+def test_text_records_vocab_mismatch_rejected(tmp_path):
+    from deeplearning_cfn_tpu.examples.llama_train import main
+
+    src = tmp_path / "corpus"
+    src.mkdir()
+    (src / "a.txt").write_text("x" * 4000)
+    datasets.convert_text(src, tmp_path / "dlc", seq_len=32)
+    # Fake a sidecar claiming a huge vocabulary.
+    (tmp_path / "dlc" / "tokenizer.json").write_text(
+        json.dumps({"tokenizer": "t", "vocab_size": 100000, "seq_len": 32})
+    )
+    with pytest.raises(SystemExit, match="vocab"):
+        main(
+            [
+                "--size", "tiny", "--seq_len", "32", "--steps", "1",
+                "--global_batch_size", "8", "--data_dir", str(tmp_path / "dlc"),
+            ]
+        )
+
+
+def test_record_paths_split_policy(tmp_path):
+    """Shared split policy (examples/common.record_paths): training
+    excludes test/val/heldout records, eval prefers them — so a trainer
+    pointed at a dir holding both splits cannot silently train on the
+    held-out data."""
+    from deeplearning_cfn_tpu.examples.common import record_paths
+
+    src = tmp_path / "corpus"
+    src.mkdir()
+    (src / "a.txt").write_text("hello " * 500)
+    datasets.convert_text(src, tmp_path / "dlc", seq_len=32, split="train")
+    datasets.convert_text(src, tmp_path / "dlc", seq_len=32, split="val")
+    _, train_paths = record_paths(str(tmp_path / "dlc"))
+    assert [p.stem for p in train_paths] == ["train"]
+    _, eval_paths = record_paths(str(tmp_path / "dlc"), eval_mode=True)
+    assert [p.stem for p in eval_paths] == ["val"]
