@@ -18,6 +18,35 @@ from deeplearning_cfn_tpu.train.metrics import ThroughputLogger
 from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
 
 
+def mlm_record_batches(args, cfg, batch: int):
+    """Token DLC1 records (``dlcfn convert --format text``) masked on the
+    fly for MLM when --data_dir is set; None = synthetic.  Shares the
+    causal-LM ingestion (split policy, sidecar vocab/seq_len contract)
+    via common.token_record_loader, reserving one id beyond the data
+    vocabulary as the mask token so masks can never collide with real
+    tokens (byte 0x00 / HF id 0 are live vocabulary entries)."""
+    from deeplearning_cfn_tpu.examples.common import token_record_loader
+    from deeplearning_cfn_tpu.train.datasets import mlm_batches
+    from deeplearning_cfn_tpu.utils.logging import get_logger
+
+    loaded = token_record_loader(
+        args, batch, cfg.vocab_size, reserve_ids=1
+    )
+    if loaded is None:
+        return None
+    loader, spec, data_vocab = loaded
+    if data_vocab:
+        mask_token = data_vocab  # first id past the data vocabulary
+    else:
+        mask_token = 0
+        get_logger("dlcfn.examples").warning(
+            "no tokenizer sidecar under --data_dir: using mask id 0, "
+            "which may collide with a real token; reconvert with "
+            "`dlcfn convert --format text` to pin the vocabulary"
+        )
+    return lambda steps: mlm_batches(loader, spec, steps, mask_token=mask_token)
+
+
 def main(argv: list[str] | None = None) -> dict:
     from deeplearning_cfn_tpu.examples.common import first_step_clock
 
@@ -25,9 +54,17 @@ def main(argv: list[str] | None = None) -> dict:
     p = base_parser(__doc__)
     p.add_argument("--seq_len", type=int, default=128)
     p.add_argument("--tiny", action="store_true", help="tiny config for smokes")
+    p.add_argument("--vocab_size", type=int, default=None,
+                   help="override the tiny config's vocabulary (e.g. 257+ "
+                        "for byte-level token records)")
     args = p.parse_args(argv)
     maybe_init_distributed()
-    cfg = bert.BertConfig.tiny(seq_len=args.seq_len) if args.tiny else bert.BertConfig.base()
+    if args.tiny:
+        cfg = bert.BertConfig.tiny(
+            seq_len=args.seq_len, vocab_size=args.vocab_size or 256
+        )
+    else:
+        cfg = bert.BertConfig.base()
     batch = args.global_batch_size or 8 * len(jax.devices())
     model = bert.BertEncoder(cfg)
     mesh = default_mesh(args.strategy)
@@ -47,7 +84,8 @@ def main(argv: list[str] | None = None) -> dict:
     ds = SyntheticMLMDataset(
         seq_len=args.seq_len, vocab_size=cfg.vocab_size, batch_size=batch
     )
-    sample = next(iter(ds.batches(1)))
+    batches = mlm_record_batches(args, cfg, batch) or ds.batches
+    sample = next(iter(batches(1)))
     state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
     ckpt = None
     if args.checkpoint_dir:
@@ -58,7 +96,7 @@ def main(argv: list[str] | None = None) -> dict:
     _sink = metrics_sink(args, 'bert')
     logger = ThroughputLogger(global_batch_size=batch, log_every=args.log_every, name="bert", sink=_sink)
     state, losses = trainer.fit(
-        state, ds.batches(args.steps), steps=args.steps, logger=logger, checkpointer=ckpt
+        state, batches(args.steps), steps=args.steps, logger=logger, checkpointer=ckpt
     )
     if ckpt:
         ckpt.save(int(state.step), state)

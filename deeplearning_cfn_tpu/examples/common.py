@@ -152,6 +152,58 @@ def record_paths(data_dir: str, eval_mode: bool = False):
     return root, paths
 
 
+def token_record_loader(
+    args,
+    batch: int,
+    model_vocab_size: int,
+    eval_mode: bool = False,
+    reserve_ids: int = 0,
+):
+    """Shared ingestion for token DLC1 records (``dlcfn convert --format
+    text``): returns ``(loader, spec, data_vocab)`` or None when
+    --data_dir is unset.  The ONE place the sidecar vocab/seq_len
+    contract is validated, used by both the causal-LM and MLM trainers.
+
+    ``reserve_ids``: ids the consumer needs beyond the data vocabulary
+    (e.g. 1 for an MLM mask id that must not collide with real tokens);
+    the model's embedding table must cover data_vocab + reserve_ids.
+    """
+    if not args.data_dir:
+        return None
+    from deeplearning_cfn_tpu.train.datasets import (
+        read_tokenizer_sidecar,
+        token_spec,
+    )
+    from deeplearning_cfn_tpu.train.native_loader import NativeRecordLoader
+
+    root, paths = record_paths(args.data_dir, eval_mode)
+    sidecar = read_tokenizer_sidecar(root)
+    data_vocab = int(sidecar.get("vocab_size", 0)) if sidecar else None
+    if data_vocab and data_vocab + reserve_ids > model_vocab_size:
+        need = f"{data_vocab} + {reserve_ids} reserved" if reserve_ids else str(data_vocab)
+        raise SystemExit(
+            f"records were tokenized with vocab_size={data_vocab} but the "
+            f"model's vocab is {model_vocab_size} (needs >= {need}); pick a "
+            "matching config or reconvert with the model's tokenizer"
+        )
+    rec_seq = int(sidecar.get("seq_len", args.seq_len)) if sidecar else args.seq_len
+    if rec_seq != args.seq_len:
+        raise SystemExit(
+            f"records hold {rec_seq}-token windows but --seq_len is "
+            f"{args.seq_len}; pass --seq_len {rec_seq}"
+        )
+    spec = token_spec(rec_seq)
+    loader = NativeRecordLoader(
+        paths,
+        spec,
+        batch_size=batch,
+        shuffle=not eval_mode,
+        loop=not eval_mode,
+        n_threads=1 if (eval_mode or jax.process_count() > 1) else 4,
+    )
+    return loader, spec, data_vocab
+
+
 def image_pipeline(args, image_shape, fallback_ds, eval_mode: bool = False):
     """(batches_fn, input_stats) for an image trainer: DLC1 records
     through the native loader when ``--data_dir`` is set (first existing

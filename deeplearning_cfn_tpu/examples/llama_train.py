@@ -23,42 +23,16 @@ from deeplearning_cfn_tpu.train.metrics import ThroughputLogger
 from deeplearning_cfn_tpu.train.trainer import TrainerConfig
 
 
-def token_record_batches(args, cfg, batch: int):
-    """Token DLC1 records (``dlcfn convert --format text``) when
-    --data_dir is set; None = synthetic.  The tokenizer sidecar's
-    vocabulary must fit the model's embedding table, and record windows
-    must match --seq_len."""
-    if not args.data_dir:
-        return None
-    from deeplearning_cfn_tpu.examples.common import record_paths
-    from deeplearning_cfn_tpu.train.datasets import (
-        read_tokenizer_sidecar,
-        token_batches,
-        token_spec,
-    )
-    from deeplearning_cfn_tpu.train.native_loader import NativeRecordLoader
+def token_record_batches(args, cfg, batch: int, eval_mode: bool = False):
+    """Token DLC1 records (``dlcfn convert --format text``) as causal-LM
+    batches when --data_dir is set; None = synthetic."""
+    from deeplearning_cfn_tpu.examples.common import token_record_loader
+    from deeplearning_cfn_tpu.train.datasets import token_batches
 
-    root, paths = record_paths(args.data_dir)
-    sidecar = read_tokenizer_sidecar(root)
-    if sidecar and int(sidecar.get("vocab_size", 0)) > cfg.vocab_size:
-        raise SystemExit(
-            f"records were tokenized with vocab_size={sidecar['vocab_size']} "
-            f"but the model's vocab is {cfg.vocab_size}; pick a matching "
-            "--size/config or reconvert with the model's tokenizer"
-        )
-    rec_seq = int(sidecar.get("seq_len", args.seq_len)) if sidecar else args.seq_len
-    if rec_seq != args.seq_len:
-        raise SystemExit(
-            f"records hold {rec_seq}-token windows but --seq_len is "
-            f"{args.seq_len}; pass --seq_len {rec_seq}"
-        )
-    spec = token_spec(rec_seq)
-    loader = NativeRecordLoader(
-        paths,
-        spec,
-        batch_size=batch,
-        n_threads=1 if jax.process_count() > 1 else 4,
-    )
+    loaded = token_record_loader(args, batch, cfg.vocab_size, eval_mode)
+    if loaded is None:
+        return None
+    loader, spec, _ = loaded
     return lambda steps: token_batches(loader, spec, steps)
 
 
@@ -77,6 +51,10 @@ def main(argv: list[str] | None = None) -> dict:
     p.add_argument("--pp_microbatches", type=int, default=0)
     p.add_argument("--experts", type=int, default=0, help="MoE experts (0 = dense)")
     p.add_argument("--ep", type=int, default=1, help="expert-parallel axis size")
+    p.add_argument("--eval_steps", type=int, default=0,
+                   help="held-out batches for corpus perplexity after "
+                        "training (0 = skip; reads the val/test split of "
+                        "--data_dir when staged)")
     args = p.parse_args(argv)
     maybe_init_distributed()
 
@@ -129,8 +107,15 @@ def main(argv: list[str] | None = None) -> dict:
         if restored is not None:
             state, _ = restored
     _sink = metrics_sink(args, 'llama')
+    from deeplearning_cfn_tpu.train.metrics import peak_flops_per_chip
+
+    peak = peak_flops_per_chip()
     logger = ThroughputLogger(
-        global_batch_size=batch * args.seq_len, log_every=args.log_every, name="llama", sink=_sink
+        global_batch_size=batch * args.seq_len, log_every=args.log_every, name="llama", sink=_sink,
+        # Analytic 6N-based flops: the honest MFU numerator on
+        # flash-attention paths (cost_analysis can't see Pallas flops).
+        flops_per_step=llama.train_flops_per_token(cfg, args.seq_len) * batch * args.seq_len,
+        peak_flops=peak * n if peak else None,
     )
     state, losses = trainer.fit(
         state, batches(args.steps), steps=args.steps, logger=logger, checkpointer=ckpt
@@ -138,13 +123,34 @@ def main(argv: list[str] | None = None) -> dict:
     if ckpt:
         ckpt.save(int(state.step), state)
         ckpt.close()
-    return {
+    result = {
         "final_loss": losses[-1],
         "steps": len(losses),
         "mesh": {"dp": dp, "fsdp": fsdp, "pp": pp, "sp": sp, "tp": tp, "ep": ep},
         "params": llama.param_count(cfg),
         "first_step_s": first_step_clock(trainer, t_main),
+        "history": logger.history,
     }
+    if args.eval_steps:
+        import math
+
+        eval_batches = token_record_batches(args, cfg, batch, eval_mode=True)
+        if eval_batches is None:
+            eval_ds = SyntheticTokenDataset(
+                seq_len=args.seq_len, vocab_size=cfg.vocab_size,
+                batch_size=batch, seed=10_000,
+            )
+            eval_batches, split = eval_ds.batches, "heldout-synthetic"
+        else:
+            from deeplearning_cfn_tpu.examples.common import has_heldout_split
+
+            split = "heldout" if has_heldout_split(args.data_dir) else "train"
+        ev = trainer.evaluate(state, eval_batches(args.eval_steps), steps=args.eval_steps)
+        # exp(mean nll), not mean of per-batch exp: the standard corpus
+        # perplexity definition.
+        ev["perplexity"] = math.exp(ev["loss"]) if "loss" in ev else None
+        result["eval"] = {"split": split, **ev}
+    return result
 
 
 if __name__ == "__main__":

@@ -38,6 +38,9 @@ def main(argv: list[str] | None = None) -> dict:
     p.add_argument("--depth", type=int, choices=sorted(DEPTHS), default=50)
     p.add_argument("--image_size", type=int, default=224)
     p.add_argument("--bf16", action=argparse.BooleanOptionalAction, default=True)
+    p.add_argument("--eval_steps", type=int, default=0,
+                   help="held-out eval batches after training (0 = skip; "
+                        "reads --data_dir's val/test split when staged)")
     args = p.parse_args(argv)
     maybe_init_distributed()
     batch = args.global_batch_size or 32 * len(jax.devices())
@@ -68,12 +71,31 @@ def main(argv: list[str] | None = None) -> dict:
         name=f"resnet{args.depth}", sink=metrics_sink(args, f"resnet{args.depth}"),
     )
     state, losses = trainer.fit(state, batches(args.steps), steps=args.steps, logger=logger)
-    return {
+    result = {
         "final_loss": losses[-1],
         "steps": len(losses),
         "history": logger.history,
         "first_step_s": first_step_clock(trainer, t_main),
     }
+    if args.eval_steps:
+        from deeplearning_cfn_tpu.examples.common import has_heldout_split
+
+        shape = (args.image_size, args.image_size, 3)
+        if args.data_dir:
+            eval_batches, _ = image_pipeline(args, shape, ds, eval_mode=True)
+            split = "heldout" if has_heldout_split(args.data_dir) else "train"
+        else:
+            eval_ds = SyntheticDataset.imagenet_like(
+                batch_size=batch, image_size=args.image_size, seed=10_000
+            )
+            eval_batches, split = eval_ds.batches, "heldout-synthetic"
+        result["eval"] = {
+            "split": split,
+            **trainer.evaluate(
+                state, eval_batches(args.eval_steps), steps=args.eval_steps
+            ),
+        }
+    return result
 
 
 if __name__ == "__main__":

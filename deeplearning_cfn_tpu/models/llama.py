@@ -239,6 +239,30 @@ def param_shardings(cfg: LlamaConfig, mesh: Mesh) -> dict:
     )
 
 
+def active_param_count(cfg: LlamaConfig) -> int:
+    """Parameters a token actually flows through: for MoE configs the
+    expert MLP banks count at top_k/n_experts (a token routes through
+    top_k experts), router and everything else fully."""
+    total = param_count(cfg)
+    if cfg.moe is None:
+        return total
+    # Expert weights: [L, E, ...] stacks of w_gate/w_up/w_down.
+    expert = 3 * cfg.n_layers * cfg.n_experts * cfg.dim * cfg.mlp_dim
+    active_expert = expert * cfg.moe_top_k // cfg.n_experts
+    return total - expert + active_expert
+
+
+def train_flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    """Analytic fwd+bwd FLOPs per trained token: the standard 6N weight
+    term (N = ACTIVE params — MoE experts count at top_k/n_experts) plus
+    the causal attention term (12·L·dim·S halved by the causal mask).
+    The honest MFU numerator for flash-attention runs —
+    ``compiled.cost_analysis()`` cannot see inside Pallas custom calls
+    (docs/BENCH_NOTES.md), so XLA-reported flops under-count exactly the
+    op this model routes through Pallas."""
+    return 6.0 * active_param_count(cfg) + 6.0 * cfg.n_layers * cfg.dim * seq_len
+
+
 def param_count(cfg: LlamaConfig) -> int:
     return sum(
         int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(

@@ -538,6 +538,33 @@ def token_batches(loader, spec: RecordSpec, steps: int | None = None):
         i += 1
 
 
+def mlm_batches(
+    loader,
+    spec: RecordSpec,
+    steps: int | None = None,
+    mask_prob: float = 0.15,
+    mask_token: int = 0,
+    seed: int = 0,
+):
+    """Mask token records on the fly for MLM pretraining: ``mask_prob`` of
+    positions are replaced with ``mask_token`` in x; y carries the
+    original ids at masked positions and -1 (ignore) elsewhere — the
+    SyntheticMLMDataset convention, over real text records."""
+    rng = np.random.default_rng(seed)
+    i = 0
+    while steps is None or i < steps:
+        raw = loader.next_raw(copy=False)
+        if raw is None:
+            return
+        tokens = spec.decode_batch(raw)["x"]
+        masked = rng.random(tokens.shape) < mask_prob
+        yield Batch(
+            x=np.where(masked, mask_token, tokens).astype(np.int32),
+            y=np.where(masked, tokens, -1).astype(np.int32),
+        )
+        i += 1
+
+
 def read_tokenizer_sidecar(root: str | Path) -> dict | None:
     try:
         return json.loads((Path(root) / "tokenizer.json").read_text())
