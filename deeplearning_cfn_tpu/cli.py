@@ -12,6 +12,7 @@ its runbooks (StackSetup.md).  Commands:
   dlcfn plan     <template.json>                  render the launch plan
   dlcfn run      <template.json>                  provision + run the job
   dlcfn convert  --format cifar10 --src D --out O   dataset -> DLC1 records
+  dlcfn status   --metrics-dir M                  latest per-worker metrics
 
 The local backend executes everything in-process (the fake cloud); the gcp
 backend renders the equivalent TPU API calls.  ``-P`` overrides template
@@ -376,6 +377,52 @@ def cmd_stage(args) -> int:
     return 0
 
 
+def cmd_status(args) -> int:
+    """Live training status from the structured per-worker metrics stream
+    (JsonlMetricsSink files on the shared mount) — the operator view the
+    reference got by tailing per-rank mpirun logs on EFS (run.sh:82),
+    machine-read instead of eyeballed."""
+    import glob as _glob
+
+    base = args.metrics_dir  # argparse enforces presence (required=True)
+    files = sorted(_glob.glob(str(Path(base) / "*" / "worker*.jsonl")))
+    if not files:
+        print(f"no metrics under {base}", file=sys.stderr)
+        return 1
+    out = []
+    for path in files:
+        run = Path(path).parent.name
+        last_step, last_eval = None, None
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail write on shared storage
+                if rec.get("event") == "train_step":
+                    last_step = rec
+                elif rec.get("event") == "eval":
+                    last_eval = rec
+        entry = {"run": run, "worker": Path(path).stem}
+        if last_step:
+            entry.update(
+                step=last_step.get("step"),
+                loss=last_step.get("loss"),
+                examples_per_sec=round(last_step.get("examples_per_sec", 0), 1),
+            )
+            if "mfu" in last_step:
+                entry["mfu"] = round(last_step["mfu"], 4)
+        if last_eval:
+            entry["eval"] = {
+                k: v
+                for k, v in last_eval.items()
+                if k not in ("ts", "process", "event", "run")
+            }
+        out.append(entry)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
 def cmd_convert(args) -> int:
     """Convert a public dataset in its standard on-disk layout into DLC1
     record files — the ingestion step the reference did with dataset tars
@@ -570,6 +617,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="local HF tokenizer dir for --format text "
                          "(default: byte-level)")
     pc.set_defaults(fn=cmd_convert)
+    # status reads the metrics stream, no template needed.
+    ps = sub.add_parser("status", help="latest per-worker training metrics")
+    ps.add_argument("--metrics-dir", dest="metrics_dir", required=True,
+                    help="the job's DLCFN_METRICS_DIR (shared mount)")
+    ps.set_defaults(fn=cmd_status)
     args = parser.parse_args(argv)
     return args.fn(args)
 

@@ -55,8 +55,9 @@ def main(argv: list[str] | None = None) -> dict:
     p.add_argument("--seq_len", type=int, default=128)
     p.add_argument("--tiny", action="store_true", help="tiny config for smokes")
     p.add_argument("--vocab_size", type=int, default=None,
-                   help="override the tiny config's vocabulary (e.g. 257+ "
-                        "for byte-level token records)")
+                   help="override the tiny config's vocabulary (byte-level "
+                        "token records need >= 258: 257 data ids + the "
+                        "reserved mask id)")
     args = p.parse_args(argv)
     maybe_init_distributed()
     if args.tiny:
@@ -64,6 +65,11 @@ def main(argv: list[str] | None = None) -> dict:
             seq_len=args.seq_len, vocab_size=args.vocab_size or 256
         )
     else:
+        if args.vocab_size:
+            raise SystemExit(
+                "--vocab_size only applies with --tiny; BertConfig.base() "
+                "is the fixed published 30522-token shape"
+            )
         cfg = bert.BertConfig.base()
     batch = args.global_batch_size or 8 * len(jax.devices())
     model = bert.BertEncoder(cfg)

@@ -147,8 +147,11 @@ def main(argv: list[str] | None = None) -> dict:
             split = "heldout" if has_heldout_split(args.data_dir) else "train"
         ev = trainer.evaluate(state, eval_batches(args.eval_steps), steps=args.eval_steps)
         # exp(mean nll), not mean of per-batch exp: the standard corpus
-        # perplexity definition.
-        ev["perplexity"] = math.exp(ev["loss"]) if "loss" in ev else None
+        # perplexity definition.  Capped exponent: a diverged run's finite
+        # loss > ~709 would otherwise OverflowError away the whole result.
+        ev["perplexity"] = (
+            math.exp(min(ev["loss"], 700.0)) if "loss" in ev else None
+        )
         result["eval"] = {"split": split, **ev}
     return result
 

@@ -97,3 +97,37 @@ def test_run_auto_recover_no_loss(template_file, capsys, contract_root):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["recoveries"] == 0
     assert out["result"]["steps"] > 0
+
+
+def test_status_reads_metrics_stream(tmp_path, capsys):
+    """dlcfn status: latest per-worker train/eval records from the JSONL
+    metrics files the trainers write on the shared mount."""
+    run_dir = tmp_path / "metrics" / "vgg11"
+    run_dir.mkdir(parents=True)
+    (run_dir / "worker0.jsonl").write_text(
+        "\n".join(
+            [
+                json.dumps({"ts": 1.0, "process": 0, "event": "train_step",
+                            "run": "vgg11", "step": 10, "loss": 2.0,
+                            "examples_per_sec": 100.0}),
+                json.dumps({"ts": 2.0, "process": 0, "event": "train_step",
+                            "run": "vgg11", "step": 20, "loss": 1.5,
+                            "examples_per_sec": 120.0, "mfu": 0.21}),
+                json.dumps({"ts": 3.0, "process": 0, "event": "eval",
+                            "run": "vgg11", "split": "heldout",
+                            "accuracy": 0.8}),
+                "{torn-partial-line",
+            ]
+        )
+        + "\n"
+    )
+    assert main(["status", "--metrics-dir", str(tmp_path / "metrics")]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out[0]["step"] == 20 and out[0]["loss"] == 1.5
+    assert out[0]["mfu"] == 0.21
+    assert out[0]["eval"]["accuracy"] == 0.8
+    assert out[0]["run"] == "vgg11"
+
+
+def test_status_empty_dir(tmp_path, capsys):
+    assert main(["status", "--metrics-dir", str(tmp_path / "nothing")]) == 1

@@ -194,3 +194,36 @@ def test_hybrid_mesh_multihost_granules(monkeypatch):
     # Slice 0 (granules 0-1 = devices 0-3) occupies the first DCN block.
     first_block = [d.id for d in m.devices.flatten()[:4]]
     assert sorted(first_block) == [0, 1, 2, 3]
+
+
+def test_multislice_loss_recovery(contract_root):
+    """Instance loss in a multi-slice cluster: RecoveryManager recreates
+    ALL slice groups and the fresh contract spans both again."""
+    from deeplearning_cfn_tpu.cluster.recovery import RecoveryManager
+
+    backend = LocalBackend(clock=FakeClock())
+    prov = Provisioner(
+        backend, make_spec(slices=2, workers=2), contract_root=contract_root
+    )
+    result = prov.provision()
+    manager = RecoveryManager(prov)
+    manager.attach(result)
+    victim = backend.describe_group("ms-test-workers-s1").instances[1]
+    backend.kill_instance(victim.instance_id)
+    assert manager.needs_recovery
+    recovered = manager.recover()
+    assert recovered.contract.workers_count == 4
+    assert recovered.contract.slices_count == 2
+    assert recovered.storage.storage_id == result.storage.storage_id
+
+
+def test_startup_script_renders_slice_identity():
+    from deeplearning_cfn_tpu.cluster.startup import render_startup_script
+
+    spec = make_spec(slices=2, workers=2, min_slices=1)
+    script = render_startup_script(spec)
+    assert "dlcfn-slice" in script  # metadata fetch for the slice ordinal
+    assert "ms-test-workers-s0,ms-test-workers-s1" in script
+    assert 'DLCFN_MIN_SLICES="${DLCFN_MIN_SLICES:-1}"' in script
+    # Coordinator election requires BOTH worker 0 and slice 0.
+    assert '"$DLCFN_WORKER_INDEX" = "0" ] && [ "${DLCFN_SLICE:-0}" = "0"' in script
