@@ -211,6 +211,12 @@ class Provisioner:
         if self.remote_agents:
             contract = self._await_remote_bootstrap(worker_q)
         else:
+            if self._inline_bootstrap_is_simulation():
+                log.warning(
+                    "inline bootstrap over the GCP backend simulates "
+                    "worker agents in-process; use --broker for a real "
+                    "deployment so on-VM agents prove readiness"
+                )
             contract = self._run_bootstrap(coord_q, worker_q)
         result = ProvisionResult(
             spec=spec,
@@ -236,6 +242,21 @@ class Provisioner:
                 )
         self.wait_until_ready()
         return result
+
+    def _inline_bootstrap_is_simulation(self) -> bool:
+        """True when inline bootstrap would assert "provisioned" against a
+        REAL cloud by simulating workers in this process — the hazard is
+        the transport being real, not the backend class (fake/refusing
+        transports are the test/dev paths inline exists for)."""
+        from deeplearning_cfn_tpu.provision.gcp import (
+            FakeGCPTransport,
+            GCPBackend,
+            NoNetworkTransport,
+        )
+
+        return isinstance(self.backend, GCPBackend) and not isinstance(
+            self.backend.transport, (FakeGCPTransport, NoNetworkTransport)
+        )
 
     def _run_bootstrap(self, coord_q, worker_q) -> ClusterContract:
         spec = self.spec
