@@ -62,19 +62,39 @@ def _write_template(tmp_path):
     return path
 
 
-def _agent_env(broker_port: int, index: int, root) -> dict[str, str]:
+def _agent_env(
+    broker_port: int,
+    index: int,
+    root,
+    cluster: str = CLUSTER,
+    groups: str | None = None,
+    budget_s: str = "90",
+    slice_idx: int | None = None,
+) -> dict[str, str]:
     env = dict(os.environ)
     env.update(
-        DLCFN_CLUSTER=CLUSTER,
+        DLCFN_CLUSTER=cluster,
         DLCFN_WORKER_INDEX=str(index),
         DLCFN_BROKER=f"127.0.0.1:{broker_port}",
-        DLCFN_GROUPS=f"{CLUSTER}-workers",
+        DLCFN_GROUPS=groups or f"{cluster}-workers",
         DLCFN_STORAGE_MOUNT="/mnt/dlcfn",
-        DLCFN_BOOTSTRAP_BUDGET_S="90",
+        DLCFN_BOOTSTRAP_BUDGET_S=budget_s,
         DLCFN_POLL_INTERVAL_S="0.2",
         DLCFN_ROOT=str(root),
     )
+    if slice_idx is not None:
+        env["DLCFN_SLICE"] = str(slice_idx)
     return env
+
+
+def _spawn_agent(env: dict[str, str]) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, "-m", "deeplearning_cfn_tpu.cluster.agent_main"],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
 
 
 def test_remote_bootstrap_end_to_end(broker, tmp_path):
@@ -180,29 +200,12 @@ def test_multislice_remote_bootstrap(broker, tmp_path):
         for widx in range(2):
             root = tmp_path / f"msvm{slice_idx}{widx}"
             vm_roots.append(root)
-            env = dict(os.environ)
-            env.update(
-                DLCFN_CLUSTER=cluster,
-                DLCFN_WORKER_INDEX=str(widx),
-                DLCFN_SLICE=str(slice_idx),
-                DLCFN_BROKER=f"127.0.0.1:{broker.port}",
-                DLCFN_GROUPS=groups,
-                DLCFN_STORAGE_MOUNT="/mnt/dlcfn",
-                DLCFN_BOOTSTRAP_BUDGET_S="90",
-                DLCFN_POLL_INTERVAL_S="0.2",
-                DLCFN_ROOT=str(root),
-            )
             agents.append(
-                subprocess.Popen(
-                    [
-                        sys.executable,
-                        "-m",
-                        "deeplearning_cfn_tpu.cluster.agent_main",
-                    ],
-                    env=env,
-                    stdout=subprocess.PIPE,
-                    stderr=subprocess.STDOUT,
-                    text=True,
+                _spawn_agent(
+                    _agent_env(
+                        broker.port, widx, root, cluster=cluster,
+                        groups=groups, slice_idx=slice_idx,
+                    )
                 )
             )
 
@@ -235,6 +238,75 @@ def test_multislice_remote_bootstrap(broker, tmp_path):
     ]
     assert all(c == contracts[0] for c in contracts[1:])
     assert len(contracts[0]["worker_ips"]) == 4
+
+
+def test_run_trains_over_production_topology(broker, tmp_path):
+    """The full stack in one command: `dlcfn run --broker` provisions via
+    real agent_main processes, then the training job runs to completion —
+    provision -> discover -> train, the reference's whole reason to exist
+    (README.md:102-143), asserted end to end."""
+    cluster = "agentrun"
+    template = {
+        "Cluster": {
+            "name": cluster,
+            "backend": "local",
+            "pool": {"accelerator_type": "local-1", "workers": 2},
+            "storage": {"kind": "local", "mount_point": "/mnt/dlcfn"},
+            "timeouts": {
+                "cluster_ready_s": 120.0,
+                "controller_launch_s": 30.0,
+                "poll_interval_s": 0.2,
+            },
+            "job": {
+                "name": "lenet",
+                "module": "deeplearning_cfn_tpu.examples.lenet_mnist",
+                "global_batch_size": 32,
+                "args": {"steps": 5, "log_every": 5},
+            },
+        }
+    }
+    tpl = tmp_path / "run.json"
+    tpl.write_text(json.dumps(template))
+
+    vm_roots = [tmp_path / f"rvm{i}" for i in range(2)]
+    agents = [
+        _spawn_agent(
+            _agent_env(
+                broker.port, i, vm_roots[i], cluster=cluster, budget_s="120"
+            )
+        )
+        for i in range(2)
+    ]
+    env = dict(os.environ, DLCFN_ROOT=str(tmp_path / "rctrl"))
+    # The controller's job runs on the 8-device virtual CPU mesh.
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    controller = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "deeplearning_cfn_tpu.cli",
+            "run",
+            str(tpl),
+            "--broker",
+            f"127.0.0.1:{broker.port}",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    ctrl_out, ctrl_err = controller.communicate(timeout=300)
+    # Collect everything, then assert the CONTROLLER first: a fast
+    # controller failure leaves the agents dying on budget exhaustion,
+    # and asserting them first would mask the root cause.
+    agent_outputs = [proc.communicate(timeout=120)[0] for proc in agents]
+    assert controller.returncode == 0, f"run failed:\n{ctrl_out}\n{ctrl_err}"
+    for i, proc in enumerate(agents):
+        assert proc.returncode == 0, f"agent {i} failed:\n{agent_outputs[i]}"
+    record = json.loads(ctrl_out.strip().splitlines()[-1])
+    assert record["job"] == "lenet"
+    assert record["result"]["steps"] == 5
+    assert record["template_to_first_step_s"] > 0
 
 
 def test_degraded_remote_bootstrap(broker, tmp_path):
