@@ -88,7 +88,10 @@ def main() -> None:
     peak = peak_flops_per_chip(devices[0])
     mfu = None
     if peak and flops_per_step:
-        mfu = flops_per_step * MEASURE_STEPS / dt / (n_chips * peak)
+        # cost_analysis flops are PER-DEVICE for an SPMD-partitioned
+        # module (verified empirically on an 8-device mesh), so per-device
+        # flop rate over per-chip peak is the per-chip MFU at any scale.
+        mfu = flops_per_step * MEASURE_STEPS / dt / peak
     print(
         json.dumps(
             {

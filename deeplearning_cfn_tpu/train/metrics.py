@@ -96,9 +96,12 @@ class ThroughputLogger:
     scalar: it is materialized (forcing a host sync) only on log steps,
     so callers in async-dispatch loops stay sync-free between logs.
 
-    With ``flops_per_step`` (from ``Trainer.compile_stats``) and
-    ``peak_flops`` (aggregate peak over the chips in use, e.g.
-    ``n_chips * peak_flops_per_chip()``), each record also carries MFU.
+    With ``flops_per_step`` and ``peak_flops``, each record also carries
+    MFU.  Match the two scopes: per-device flops (what
+    ``Trainer.compile_stats`` reports — cost_analysis is per-device under
+    SPMD partitioning) pair with the per-chip peak; GLOBAL analytic flops
+    (e.g. llama.train_flops_per_token x global tokens) pair with
+    ``n_chips * peak_flops_per_chip()``.
     """
 
     global_batch_size: int

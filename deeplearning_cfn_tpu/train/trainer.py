@@ -377,25 +377,43 @@ class Trainer:
             self._eval_fn = self._build_eval_step()
         return self._eval_fn
 
-    def evaluate(self, state: TrainState, batches, steps: int | None = None) -> dict:
+    def evaluate(
+        self,
+        state: TrainState,
+        batches,
+        steps: int | None = None,
+        prefetch: int = 2,
+    ) -> dict:
         """Run the no-gradient eval step over a batch iterator and return
         example-weighted mean metrics (plus ``examples`` seen).  The held-
         out counterpart of the reference's train-accuracy walkthrough
-        metric (README.md:141)."""
+        metric (README.md:141).  ``prefetch`` overlaps host batch
+        production and transfer with eval compute, as in fit()."""
+        from deeplearning_cfn_tpu.train.data import DevicePrefetcher
+
         eval_fn = self.eval_step
         # islice, not enumerate+break: break would pull (and discard) one
         # batch past the limit from the caller's iterator.
         if steps is not None:
             batches = itertools.islice(batches, steps)
+        prefetcher: DevicePrefetcher | None = None
+        if prefetch > 0:
+            batches = prefetcher = DevicePrefetcher(
+                batches, self.batch_sharding, prefetch
+            )
         # Device scalars accumulate host-side and materialize in ONE
         # readback at the end — a per-batch float() would serialize the
         # eval loop on device round-trips just like the old fit() did.
         per_batch: list[tuple[int, dict]] = []
-        for batch in batches:
-            x, y = device_put_batch(batch, self.batch_sharding)
-            with jax.set_mesh(self.mesh):
-                metrics = eval_fn(state, x, y)
-            per_batch.append((len(batch.x), metrics))
+        try:
+            for batch in batches:
+                x, y = device_put_batch(batch, self.batch_sharding)
+                with jax.set_mesh(self.mesh):
+                    metrics = eval_fn(state, x, y)
+                per_batch.append((len(batch.x), metrics))
+        finally:
+            if prefetcher is not None:
+                prefetcher.close()
         counts = [n for n, _ in per_batch]
         examples = sum(counts)
         if examples == 0:
@@ -502,6 +520,11 @@ class Trainer:
 
     # --- compile diagnostics ---------------------------------------------
     def compile_stats(self, state: TrainState, x: jax.Array, y: jax.Array) -> dict:
+        """AOT-compile the train step and report cost analysis.  NOTE:
+        ``flops_per_step`` is PER-DEVICE for an SPMD-partitioned module
+        (each device executes the partitioned program over its batch
+        shard) — pair it with the per-chip peak for MFU.  The compile
+        populates the jit dispatch cache, so it is not paid twice."""
         t0 = time.perf_counter()
         # Same mesh context as train_step: without it, in-model sharding
         # hints are dropped and this would measure (and compile) a different
