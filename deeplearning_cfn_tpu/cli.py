@@ -118,9 +118,13 @@ def _progress_printer(elapsed_s: float, status: str) -> None:
 def cmd_validate(args) -> int:
     spec = _load_spec(args)
     print(json.dumps(spec.to_dict(), indent=2, default=str))
+    slices = (
+        f"{spec.pool.slices} slices x " if spec.pool.slices > 1 else ""
+    )
     print(
-        f"OK: {spec.pool.num_workers} workers x {spec.pool.chips_per_worker} chips "
-        f"({spec.pool.accelerator_type}) on backend {spec.backend}",
+        f"OK: {slices}{spec.pool.num_workers} workers x "
+        f"{spec.pool.chips_per_worker} chips ({spec.pool.accelerator_type}, "
+        f"{spec.pool.total_chips} chips total) on backend {spec.backend}",
         file=sys.stderr,
     )
     return 0

@@ -177,3 +177,51 @@ def test_reset_cluster_state_scrubs_previous_generation(broker):
     assert fresh.get_resource_signal(ready) is None
     assert fresh.get_resource_signal("group:gen-workers") is None
     assert fresh.get_queue("gen-worker-queue").receive(visibility_timeout_s=0.0) == []
+
+
+def test_concurrent_clients_stress(broker):
+    """20 threads x (KV set/get + queue send/receive/delete) against one
+    broker: no lost messages, no cross-talk, no torn values — the C++
+    broker serves every agent of a large cluster concurrently."""
+    import json
+    import threading
+
+    from deeplearning_cfn_tpu.cluster.broker_client import BrokerConnection
+
+    N, PER = 20, 25
+    errors: list[str] = []
+
+    def worker(i: int) -> None:
+        try:
+            c = BrokerConnection("127.0.0.1", broker.port)
+            q = broker.queue(f"stress-{i}")  # private queue per thread
+            for j in range(PER):
+                payload = {"thread": i, "seq": j, "blob": "x" * 200}
+                q.send(payload)
+                c.set(f"stress-key-{i}", json.dumps(payload).encode())
+            got = []
+            while len(got) < PER:
+                msgs = q.receive(max_messages=10, visibility_timeout_s=60)
+                for m in msgs:
+                    got.append(m.body)
+                    q.delete(m.receipt)
+            assert len(got) == PER
+            assert {g["seq"] for g in got} == set(range(PER))
+            assert all(g["thread"] == i for g in got)
+            raw = c.get(f"stress-key-{i}")
+            assert raw is not None
+            last = json.loads(raw.decode())
+            assert last["thread"] == i and last["seq"] == PER - 1
+            c.close()
+        except Exception as e:  # surface in the main thread
+            errors.append(f"thread {i}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    # A hung worker (e.g. a lost message spinning the receive loop) must
+    # fail the test, not silently time out of join().
+    assert not any(t.is_alive() for t in threads), "worker thread hung"
+    assert not errors, errors

@@ -227,3 +227,20 @@ def test_startup_script_renders_slice_identity():
     assert 'DLCFN_MIN_SLICES="${DLCFN_MIN_SLICES:-1}"' in script
     # Coordinator election requires BOTH worker 0 and slice 0.
     assert '"$DLCFN_WORKER_INDEX" = "0" ] && [ "${DLCFN_SLICE:-0}" = "0"' in script
+
+
+def test_shipped_multislice_template_renders():
+    from pathlib import Path
+
+    from deeplearning_cfn_tpu.config.template import render_template_file
+
+    template = (
+        Path(__file__).resolve().parent.parent
+        / "templates"
+        / "multislice-cluster.json"
+    )
+    spec = render_template_file(template, {"Project": "p", "Slices": "4"})
+    spec.validate()
+    assert spec.pool.slices == 4
+    assert spec.pool.min_slices == 1
+    assert spec.job.args["seq_len"] == 2048  # nested ref resolved
