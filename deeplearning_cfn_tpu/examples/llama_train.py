@@ -2,7 +2,9 @@
 
 The BASELINE.json flagship: "Llama-3 8B (FSDP-style param sharding via pjit
 on the provisioned v5p slice)".  ``--size 8b`` selects the real shape;
-``--size tiny`` smokes the identical code path on small hardware.
+``--size 435m`` is the measured single-chip benchmark shape
+(docs/BENCH_NOTES.md); ``--size tiny`` smokes the identical code path on
+small hardware.
 
 Run: ``python -m deeplearning_cfn_tpu.examples.llama_train --size tiny --steps 20``
 """
@@ -41,7 +43,7 @@ def main(argv: list[str] | None = None) -> dict:
 
     t_main = first_step_clock()
     p = base_parser(__doc__)
-    p.add_argument("--size", choices=["tiny", "8b"], default="tiny")
+    p.add_argument("--size", choices=["tiny", "435m", "8b"], default="tiny")
     p.add_argument("--seq_len", type=int, default=512)
     p.add_argument("--fsdp", type=int, default=None, help="fsdp axis size (default: all devices)")
     p.add_argument("--tp", type=int, default=1)
@@ -66,6 +68,8 @@ def main(argv: list[str] | None = None) -> dict:
 
     if args.size == "8b":
         cfg = llama.LlamaConfig.llama3_8b()
+    elif args.size == "435m":
+        cfg = llama.LlamaConfig.m435(seq_len=args.seq_len)
     else:
         cfg = llama.LlamaConfig.tiny(vocab_size=512, seq_len=args.seq_len)
     if args.ring_attention:

@@ -109,6 +109,23 @@ class LlamaConfig:
         return cls()  # defaults above are the 8B shape
 
     @classmethod
+    def m435(cls, seq_len: int = 1024) -> "LlamaConfig":
+        """The ~435M single-chip benchmark shape (docs/BENCH_NOTES.md:
+        21.3k tok/s at 30% analytic MFU on one v5e) — big enough to fill
+        the MXU, small enough for one 16 GB chip with adamw."""
+        return cls(
+            vocab_size=32000,
+            dim=1024,
+            n_layers=24,
+            n_heads=16,
+            n_kv_heads=16,
+            mlp_dim=4096,
+            max_seq_len=seq_len,
+            tied_embeddings=True,
+            use_flash_attention=True,
+        )
+
+    @classmethod
     def tiny(cls, vocab_size: int = 256, seq_len: int = 128, **kw) -> "LlamaConfig":
         return cls(
             vocab_size=vocab_size,
