@@ -77,15 +77,21 @@ class RecordSpec:
         return b"".join(parts)
 
     def decode_batch(self, buf: np.ndarray) -> dict[str, np.ndarray]:
-        """[B, record_size] u8 -> {name: [B, *shape]}, one copy per field
-        (the strided field slice must be compacted before the dtype view)."""
+        """[B, record_size] u8 -> {name: [B, *shape]}, EXACTLY one copy per
+        field — never a view of ``buf``.  Strided field slices must be
+        compacted before the dtype view anyway; the copy must also happen
+        for a full-width field (where ``ascontiguousarray`` would be a
+        no-op and return ``buf`` itself), because callers feed the native
+        loader's reuse buffer (``next_raw(copy=False)``): a yielded view
+        would be silently overwritten by the next batch while a prefetch
+        transfer is still in flight."""
         if buf.ndim != 2 or buf.shape[1] != self.record_size:
             raise RecordFormatError(
                 f"batch buffer {buf.shape} != [B, {self.record_size}]"
             )
         out = {}
         for f, off in zip(self.fields, self.offsets()):
-            raw = np.ascontiguousarray(buf[:, off : off + f.nbytes])
+            raw = buf[:, off : off + f.nbytes].copy()
             out[f.name] = raw.view(f.dtype).reshape(buf.shape[0], *f.shape)
         return out
 

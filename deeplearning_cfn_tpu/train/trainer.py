@@ -263,21 +263,24 @@ class Trainer:
 
     def _opt_state_shardings(self, abstract_params: Any, param_sh: Any) -> Any:
         """Optimizer state mirrors parameter sharding (moments are
-        param-shaped); scalars are replicated."""
+        param-shaped); everything else (step counts, EMA scalars) is
+        replicated.
+
+        The mapping is PATH-aligned via ``optax.tree_map_params``, never
+        by shape: two params with the same shape but different layouts
+        (llama's ``wq`` P(None,fsdp,tp) vs ``wo`` P(None,tp,fsdp) — both
+        [L,D,D] at MHA shapes) must each get their OWN sharding for their
+        adam moments, or XLA silently inserts resharding collectives on
+        the moments every step."""
         opt_shape = jax.eval_shape(self.tx.init, abstract_params)
-        flat_params, _ = jax.tree_util.tree_flatten(abstract_params)
-        flat_shardings, _ = jax.tree_util.tree_flatten(param_sh)
-        shape_to_sh = {}
-        for p, s in zip(flat_params, flat_shardings):
-            shape_to_sh.setdefault((p.shape, p.dtype), s)
-
-        def pick(leaf):
-            key = (leaf.shape, leaf.dtype)
-            if key in shape_to_sh:
-                return shape_to_sh[key]
-            return replicated(self.mesh)
-
-        return jax.tree_util.tree_map(pick, opt_shape)
+        rep = replicated(self.mesh)
+        return optax.tree_map_params(
+            self.tx,
+            lambda _leaf, sh: sh,
+            opt_shape,
+            param_sh,
+            transform_non_params=lambda _leaf: rep,
+        )
 
     # --- the step -------------------------------------------------------
     def _build_step(self):

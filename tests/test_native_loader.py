@@ -175,6 +175,52 @@ def test_next_raw_copies_by_default(tmp_path):
         np.testing.assert_array_equal(first, snapshot)
 
 
+def test_decode_batch_never_aliases_the_reuse_buffer(tmp_path):
+    """Round-2 advisor (high): a single full-width field made
+    ``ascontiguousarray`` a no-op, so token_batches yielded views of the
+    loader's reuse buffer — overwritten by the next batch while a device
+    prefetch transfer could still be in flight.  decode_batch must copy
+    even in the full-width case."""
+    seq = 6
+    spec = RecordSpec((Field("x", "int32", (seq,)),))
+    recs = [
+        spec.encode(x=np.full((seq,), i, np.int32)) for i in range(8)
+    ]
+    path = tmp_path / "tok.dlc"
+    write_records(path, spec, recs)
+    with NativeRecordLoader(
+        [path], spec, batch_size=4, n_threads=1, shuffle=False, loop=True
+    ) as loader:
+        raw = loader.next_raw(copy=False)
+        decoded = spec.decode_batch(raw)["x"]
+        assert not np.shares_memory(decoded, raw)
+        snapshot = decoded.copy()
+        loader.next_raw(copy=False)  # overwrites the reuse buffer
+        np.testing.assert_array_equal(decoded, snapshot)
+
+
+def test_token_batches_survive_buffer_reuse(tmp_path):
+    """End-to-end form of the aliasing fix: a held token Batch must be
+    stable across subsequent pulls (the DevicePrefetcher pattern)."""
+    from deeplearning_cfn_tpu.train.datasets import token_batches, token_spec
+
+    seq = 5
+    spec = token_spec(seq)
+    recs = [spec.encode(x=np.full((seq,), i, np.int32)) for i in range(12)]
+    path = tmp_path / "tok.dlc"
+    write_records(path, spec, recs)
+    with NativeRecordLoader(
+        [path], spec, batch_size=4, n_threads=1, shuffle=False, loop=True
+    ) as loader:
+        it = token_batches(loader, spec)
+        first = next(it)
+        x0, y0 = first.x.copy(), first.y.copy()
+        next(it)
+        next(it)
+        np.testing.assert_array_equal(first.x, x0)
+        np.testing.assert_array_equal(first.y, y0)
+
+
 def test_closed_loader_raises_not_segfaults(tmp_path):
     path = _write(tmp_path, "a.dlc", range(8))
     loader = NativeRecordLoader([path], SPEC, batch_size=4)

@@ -1,0 +1,106 @@
+"""Optimizer-state sharding must be PATH-aligned with parameters.
+
+Round-2 verdict (confirmed empirically there): the old (shape, dtype)
+first-wins lookup in ``Trainer._opt_state_shardings`` collided llama's
+``wq``/``wv`` (P(None, fsdp, tp)) with ``wo`` (P(None, tp, fsdp)) — all
+[L, D, D] at MHA shapes — landing half the adam moments TRANSPOSED
+relative to their parameters on the flagship fsdp x tp layout.  XLA then
+resharded those moments every step, silently.  These tests pin the fix:
+every param-shaped optimizer leaf's committed sharding equals its
+parameter's, verified on the real post-init arrays (the same observation
+method that confirmed the bug).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deeplearning_cfn_tpu.models import llama
+from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
+from deeplearning_cfn_tpu.train.trainer import TrainerConfig
+
+
+def _assert_moments_match_params(state) -> int:
+    """Every optimizer leaf whose tree path ends with a parameter's path
+    (and matches its shape) must carry an equivalent sharding.  Returns
+    the number of leaves checked."""
+    params_by_path = {
+        tuple(str(k) for k in path): leaf
+        for path, leaf in jax.tree_util.tree_leaves_with_path(state.params)
+    }
+    checked = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(state.opt_state):
+        keys = tuple(str(k) for k in path)
+        for start in range(len(keys)):
+            param = params_by_path.get(keys[start:])
+            if param is not None:
+                break
+        else:
+            continue
+        if param.shape != leaf.shape:
+            continue
+        assert leaf.sharding.is_equivalent_to(param.sharding, leaf.ndim), (
+            f"opt leaf {jax.tree_util.keystr(path)}: sharding "
+            f"{leaf.sharding.spec} != param's {param.sharding.spec}"
+        )
+        checked += 1
+    return checked
+
+
+@pytest.fixture(scope="module")
+def llama_state():
+    mesh = build_mesh(MeshSpec(fsdp=2, tp=2), jax.devices()[:4])
+    cfg = llama.LlamaConfig.tiny(vocab_size=64, seq_len=8)
+    trainer = llama.make_trainer(
+        cfg,
+        mesh,
+        TrainerConfig(strategy="fsdp", optimizer="adamw", learning_rate=1e-3),
+    )
+    tokens = np.zeros((4, cfg.max_seq_len), dtype=np.int32)
+    x = jax.device_put(jnp.asarray(tokens), trainer.batch_sharding)
+    state = trainer.init(jax.random.key(0), x)
+    return trainer, state
+
+
+def test_llama_adam_moments_shardings_equal_params(llama_state):
+    _, state = llama_state
+    n_params = len(jax.tree_util.tree_leaves(state.params))
+    checked = _assert_moments_match_params(state)
+    # adamw carries mu + nu, each mirroring the full param tree.
+    assert checked >= 2 * n_params
+
+
+def test_llama_wq_wo_moments_not_collided(llama_state):
+    """The specific round-2 collision: wq and wo are both [L, D, D] but
+    differently laid out; their moments must differ the same way."""
+    _, state = llama_state
+    mu = state.opt_state[0].mu
+    layers = mu["layers"] if "layers" in mu else mu
+    assert layers["wq"].sharding.spec == P(None, "fsdp", "tp")
+    assert layers["wo"].sharding.spec == P(None, "tp", "fsdp")
+
+
+@pytest.mark.parametrize("optimizer", ["momentum", "lamb"])
+def test_other_optimizers_path_aligned(optimizer):
+    """The fix must hold for every supported optimizer, including ones
+    whose state nests differently (momentum's trace, lamb's moments)."""
+    mesh = build_mesh(MeshSpec(fsdp=2, tp=2), jax.devices()[:4])
+    cfg = llama.LlamaConfig.tiny(vocab_size=64, seq_len=8)
+    trainer = llama.make_trainer(
+        cfg,
+        mesh,
+        TrainerConfig(
+            strategy="fsdp",
+            optimizer=optimizer,
+            learning_rate=1e-3,
+            grad_clip_norm=1.0,
+        ),
+    )
+    tokens = np.zeros((4, cfg.max_seq_len), dtype=np.int32)
+    x = jax.device_put(jnp.asarray(tokens), trainer.batch_sharding)
+    state = trainer.init(jax.random.key(0), x)
+    assert _assert_moments_match_params(state) >= len(
+        jax.tree_util.tree_leaves(state.params)
+    )
