@@ -60,11 +60,59 @@ class ClusterContract:
     ) -> "ClusterContract":
         # Coordinator doubles as worker 0 (StackSetup.md:110-111); its IP is
         # prepended and the rest sorted for a stable order (dl_cfn_setup_v2.py:330-342).
-        rest = sorted(ip for ip in other_worker_ips if ip != coordinator_ip)
+        #
+        # Multi-slice: process ids follow worker_ips order, and
+        # build_hybrid_mesh's process-granule fallback reshapes CONSECUTIVE
+        # process blocks into the DCN axes (parallel/mesh.py) — so each
+        # slice's IPs must stay contiguous (a global lexicographic sort
+        # would interleave slices and silently put per-step ICI collectives
+        # over DCN).  Coordinator's slice comes first (it holds process 0);
+        # the stored ``slices`` is normalized so its concatenation IS
+        # worker_ips.
+        if slices:
+            coord_slice = next(
+                (g for g, ips in slices.items() if coordinator_ip in ips), None
+            )
+            if coord_slice is None:
+                # Prepending the coordinator outside the topology would
+                # shift every process id by one relative to the slices —
+                # the exact misalignment this ordering exists to prevent.
+                raise ValueError(
+                    f"coordinator {coordinator_ip} is not in any slice"
+                )
+            names = sorted(slices, key=lambda g: (g != coord_slice, g))
+            norm: dict[str, list[str]] = {}
+            for g in names:
+                members = sorted(ip for ip in slices[g] if ip != coordinator_ip)
+                if g == coord_slice:
+                    members = [coordinator_ip] + members
+                norm[g] = members
+            worker_ips = [ip for ips in norm.values() for ip in ips]
+            covered = set(worker_ips)
+            if len(worker_ips) != len(covered):
+                dupes = sorted(
+                    {ip for ip in worker_ips if worker_ips.count(ip) > 1}
+                )
+                raise ValueError(f"duplicate IPs in slice topology: {dupes}")
+            known = set(other_worker_ips) | {coordinator_ip}
+            leftover = sorted(known - covered)
+            if leftover:
+                raise ValueError(
+                    f"worker IPs missing from slice topology: {leftover}"
+                )
+            phantom = sorted(covered - known)
+            if phantom:
+                raise ValueError(
+                    f"slice topology names IPs discovery never reported: {phantom}"
+                )
+            slices = norm
+        else:
+            rest = sorted(ip for ip in other_worker_ips if ip != coordinator_ip)
+            worker_ips = [coordinator_ip] + rest
         return cls(
             cluster_name=cluster_name,
             coordinator_ip=coordinator_ip,
-            worker_ips=[coordinator_ip] + rest,
+            worker_ips=worker_ips,
             chips_per_worker=chips_per_worker,
             storage_mount=storage_mount,
             degraded=degraded,

@@ -292,6 +292,25 @@ def param_count(cfg: LlamaConfig) -> int:
 
 from deeplearning_cfn_tpu.parallel.sharding import maybe_shard as _maybe_shard
 
+
+def attention_kind(
+    cfg: LlamaConfig, mesh: Mesh | None, seq_len: int, backend: str | None = None
+) -> str:
+    """Which attention implementation a block will use: ``ring`` (sp > 1),
+    ``flash`` (Pallas kernel, TPU at/above the measured crossover), or
+    ``xla`` (fused XLA attention — also the fastest choice below the
+    crossover and the correctness path off-TPU)."""
+    if cfg.use_ring_attention and mesh is not None and mesh.shape.get("sp", 1) > 1:
+        return "ring"
+    backend = backend or jax.default_backend()
+    if cfg.use_flash_attention and backend == "tpu":
+        from deeplearning_cfn_tpu.ops.pallas_attention import FLASH_CROSSOVER_SEQ
+
+        if seq_len >= FLASH_CROSSOVER_SEQ:
+            return "flash"
+    return "xla"
+
+
 def _block(
     cfg: LlamaConfig,
     mesh: Mesh | None,
@@ -309,17 +328,21 @@ def _block(
     v = (h @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
     q = rotary_embedding(q, positions, cfg.rope_theta)
     k = rotary_embedding(k, positions, cfg.rope_theta)
-    if cfg.use_ring_attention and mesh is not None and mesh.shape.get("sp", 1) > 1:
+    kind = attention_kind(cfg, mesh, S)
+    if kind == "ring":
         from deeplearning_cfn_tpu.parallel.ring_attention import ring_attention
 
         attn = ring_attention(q, k, v, mesh, causal=True)
-    elif cfg.use_flash_attention and jax.default_backend() == "tpu":
+    elif kind == "flash":
         from deeplearning_cfn_tpu.ops.pallas_attention import flash_attention
 
         attn = flash_attention(q, k, v, causal=True, mesh=mesh)
     else:
-        # Includes use_flash_attention off-TPU: the Pallas kernel would run
-        # in interpret mode (slow); XLA attention is equivalent there.
+        # "xla" covers use_flash_attention off-TPU (the Pallas kernel would
+        # run in interpret mode — slow) AND below-crossover sequences where
+        # XLA's fused attention measures faster than the Pallas kernel
+        # (docs/BENCH_NOTES.md): use_flash means "fastest memory-safe
+        # attention", not "always Pallas".
         attn = dot_product_attention(q, k, v, causal=True)
     x = x + attn.reshape(B, S, cfg.n_heads * hd) @ lp["wo"]
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)

@@ -46,11 +46,18 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 # Measured on v5e (S=2048, H=8, D=64, bf16): 512x512 blocks run the
-# forward ~40% faster than 128x128 (4.7 ms vs 6.5 ms; 1024x512 reaches
-# XLA-attention parity at 3.8 ms).  Small-S inputs clamp down to the
-# sequence length, so large defaults cost nothing for short sequences.
-DEFAULT_BLOCK_Q = 512
+# forward ~40% faster than 128x128 (4.7 ms vs 6.5 ms), and 1024x512 is
+# the measured best (3.78 ms — docs/BENCH_NOTES.md block sweep), so it is
+# the default.  Small-S inputs clamp down to the sequence length, so
+# large defaults cost nothing for short sequences.
+DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 512
+
+# Below this sequence length XLA's fused attention wins on v5e (measured:
+# 3.74 ms XLA vs 4.69 ms flash at S=2048 with 512 blocks; flash pulls
+# ahead from S=2048 with 1024x512 blocks and is 2x faster by S=4096).
+# Dispatchers (models/llama.py) fall back to XLA attention under this.
+FLASH_CROSSOVER_SEQ = 2048
 
 # Sublane tile granularity: 16 covers both f32 (8) and bf16 (16) tiles, so
 # clamped block sizes always satisfy Mosaic's (sublane, lane) constraints.
@@ -327,26 +334,46 @@ def _flash_core(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     return out
 
 
+# A larger block is kept over a smaller one unless the smaller block's
+# padded length saves more than this fraction — the MXU-efficiency gap
+# between block sizes (40% from 128 to 512, BENCH_NOTES) dwarfs
+# single-digit padding savings.
+_PAD_TOLERANCE = 0.125
+# Blocks below 128 underutilize the MXU (128x128 systolic array); never
+# step below it for padding reasons when the sequence allows 128.
+_MIN_MXU_BLOCK = 128
+
+
 def _clamp_block(block: int, seq: int) -> int:
-    """Effective block size: the largest candidate <= ``block`` that
-    minimizes the padded sequence length ``round_up(seq, b)``.
+    """Effective block size: the largest candidate <= ``block`` whose
+    padded sequence length ``round_up(seq, b)`` is within
+    ``_PAD_TOLERANCE`` of the minimum, with candidates floored at the MXU
+    tile (128) whenever the sequence reaches it.
 
     Large blocks run fastest on the MXU (docs/BENCH_NOTES.md: 512x512 is
     ~40% faster than 128x128 at S=2048), but padding cost grows with the
     block: a ragged S=600 under a 512 block pads to 1024 (~2.5x the
-    attention FLOPs of a 128 block's 640).  Stepping candidates down by
-    powers of two keeps big blocks for aligned sequences and spends no
-    padded compute on ragged ones."""
+    attention FLOPs of a 128 block's 640).  Strictly minimizing padding
+    overshoots the other way — S=600 would pick a 32 block (padded 608)
+    over 128 (padded 640), trading ~5% padding for a far larger MXU
+    efficiency loss — hence the floor and the tolerance."""
     seq_t = _round_up(max(seq, _SUBLANE), _SUBLANE)
-    best_block = _SUBLANE
-    best_padded = None
+    floor = min(_MIN_MXU_BLOCK, seq_t)
+    candidates = []
     b = _round_up(block, _SUBLANE)
-    while b >= _SUBLANE:
-        padded = _round_up(seq_t, b)
-        if best_padded is None or padded < best_padded:
-            best_padded, best_block = padded, b
-        b //= 2
-    return min(best_block, seq_t)
+    while b >= floor:
+        candidates.append((b, _round_up(seq_t, b)))
+        if b > floor and b // 2 < floor:
+            b = floor  # non-power-of-two ladders must still consider the floor
+        else:
+            b //= 2
+    if not candidates:  # block < floor: honor the caller's small block
+        return min(_round_up(block, _SUBLANE), seq_t)
+    min_padded = min(p for _, p in candidates)
+    for b, padded in candidates:  # descending block size
+        if padded <= min_padded * (1.0 + _PAD_TOLERANCE):
+            return min(b, seq_t)
+    return min(candidates[-1][0], seq_t)
 
 
 def _core_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):

@@ -144,6 +144,69 @@ def test_contract_carries_slice_topology(contract_root):
     assert contract.env(contract_root)["DEEPLEARNING_SLICES_COUNT"] == "2"
 
 
+def test_contract_orders_workers_slice_contiguously():
+    """Round-2 advisor (medium): a global lexicographic IP sort
+    ('10.0.0.10' < '10.0.0.2') interleaved slice members, breaking
+    build_hybrid_mesh's consecutive-process-blocks fallback and silently
+    putting per-step ICI collectives over DCN.  worker_ips must be the
+    concatenation of the slices (coordinator's slice first, coordinator
+    at its head), and the stored topology must agree exactly."""
+    from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+
+    contract = ClusterContract.build(
+        cluster_name="ms",
+        coordinator_ip="10.0.0.2",
+        other_worker_ips=["10.0.0.10", "10.0.0.2", "10.0.0.3", "10.0.0.1"],
+        chips_per_worker=4,
+        storage_mount="/mnt",
+        # Coordinator's slice deliberately NOT first alphabetically.
+        slices={
+            "ms-workers-s1": ["10.0.0.3", "10.0.0.1"],
+            "ms-workers-s0": ["10.0.0.2", "10.0.0.10"],
+        },
+    )
+    assert contract.worker_ips == [
+        "10.0.0.2", "10.0.0.10", "10.0.0.1", "10.0.0.3",
+    ]
+    # slices concatenation IS worker_ips (process id -> slice derivable).
+    assert [ip for ips in contract.slices.values() for ip in ips] == (
+        contract.worker_ips
+    )
+    assert list(contract.slices) == ["ms-workers-s0", "ms-workers-s1"]
+
+
+def test_contract_rejects_inconsistent_slice_topology():
+    """Topology and discovery must agree in BOTH directions, with no
+    duplicates and the coordinator inside a slice — any mismatch shifts
+    or inflates the process-id -> slice mapping silently."""
+    from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+
+    def build(coordinator="10.0.0.2", workers=None, slices=None):
+        return ClusterContract.build(
+            cluster_name="ms",
+            coordinator_ip=coordinator,
+            other_worker_ips=workers or ["10.0.0.2", "10.0.0.9"],
+            chips_per_worker=4,
+            storage_mount="/mnt",
+            slices=slices,
+        )
+
+    with pytest.raises(ValueError, match="missing from slice topology"):
+        build(slices={"s0": ["10.0.0.2"]})
+    with pytest.raises(ValueError, match="not in any slice"):
+        build(slices={"s0": ["10.0.0.9"]})
+    with pytest.raises(ValueError, match="duplicate IPs"):
+        build(
+            workers=["10.0.0.2", "10.0.0.9"],
+            slices={"s0": ["10.0.0.2", "10.0.0.9"], "s1": ["10.0.0.9"]},
+        )
+    with pytest.raises(ValueError, match="never reported"):
+        build(
+            workers=["10.0.0.2", "10.0.0.9"],
+            slices={"s0": ["10.0.0.2", "10.0.0.9"], "s1": ["10.0.0.7"]},
+        )
+
+
 def test_hybrid_mesh_for_slices():
     import jax
 

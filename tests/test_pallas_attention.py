@@ -4,6 +4,8 @@ Covers: causal/non-causal, GQA, non-divisible sequence lengths (padding +
 masking), and gradients through the custom VJP.
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -70,18 +72,50 @@ def test_gradients_match(hq, hkv):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
 
 
-def test_block_picker_minimizes_padding():
+def test_block_picker_balances_padding_against_block_size():
     """Effective block selection: keep the big (fast) block for aligned
     sequences, step down for ragged ones instead of paying up to 2.5x in
-    padded attention FLOPs (512-block on S=600 would pad to 1024)."""
+    padded attention FLOPs (512-block on S=600 would pad to 1024) — but
+    never chase the last few percent of padding down to a tiny block:
+    round-2 advisor flagged S=600 picking 32 (padded 608) over 128
+    (padded 640), trading ~5% padding for a ~40% MXU-efficiency loss."""
     from deeplearning_cfn_tpu.ops.pallas_attention import _clamp_block
 
     assert _clamp_block(512, 2048) == 512  # aligned: biggest block wins
+    assert _clamp_block(1024, 2048) == 1024  # measured-best default
     assert _clamp_block(512, 4096) == 512
     assert _clamp_block(512, 128) == 128  # short seq: clamp to length
     assert _clamp_block(128, 8) == 16  # sublane floor
-    assert _clamp_block(512, 600) == 32  # 608 = 19*32: zero padding
+    # Ragged: 128 pads to 640, within tolerance of the 608 minimum; the
+    # tiny 32 block is NOT chosen for its ~5% padding saving.
+    assert _clamp_block(512, 600) == 128
     assert _clamp_block(512, 640) == 128  # 640 = 5*128: zero padding
+    # Far-from-aligned: 512 pads 600->1024 (+68%), rightly rejected.
+    assert _clamp_block(512, 520) == 128  # 128 pads to 640 vs min 528 @16
+    # Tolerance respects genuinely large savings: stepping to 16 saves
+    # >12.5% only when no bigger block comes close.
+    assert _clamp_block(16, 600) == 16
+    # Non-power-of-two caller blocks still consider the 128 floor: 384's
+    # halving ladder (384, 192, 96...) must not skip over it.
+    assert _clamp_block(384, 600) == 128
+
+
+def test_llama_attention_dispatch_crossover():
+    """use_flash_attention means "fastest memory-safe attention": below
+    the measured v5e crossover XLA's fused attention wins (3.74 vs
+    4.69 ms at S=2048 with round-2 blocks, BENCH_NOTES), so the llama
+    path must fall back to XLA there instead of dispatching to the
+    Pallas kernel unconditionally."""
+    from deeplearning_cfn_tpu.models.llama import LlamaConfig, attention_kind
+    from deeplearning_cfn_tpu.ops.pallas_attention import FLASH_CROSSOVER_SEQ
+
+    cfg = LlamaConfig.tiny(vocab_size=64, seq_len=FLASH_CROSSOVER_SEQ)
+    cfg = dataclasses.replace(cfg, use_flash_attention=True)
+    assert attention_kind(cfg, None, FLASH_CROSSOVER_SEQ, backend="tpu") == "flash"
+    assert attention_kind(cfg, None, FLASH_CROSSOVER_SEQ - 1, backend="tpu") == "xla"
+    assert attention_kind(cfg, None, FLASH_CROSSOVER_SEQ, backend="cpu") == "xla"
+    off = dataclasses.replace(cfg, use_flash_attention=False)
+    assert attention_kind(off, None, FLASH_CROSSOVER_SEQ, backend="tpu") == "xla"
 
 
 def test_bad_gqa_ratio_raises():
