@@ -70,16 +70,28 @@ class ClusterContract:
         # the stored ``slices`` is normalized so its concatenation IS
         # worker_ips.
         if slices:
-            coord_slice = next(
-                (g for g, ips in slices.items() if coordinator_ip in ips), None
-            )
-            if coord_slice is None:
+            coord_slices = [
+                g for g, ips in slices.items() if coordinator_ip in ips
+            ]
+            if not coord_slices:
                 # Prepending the coordinator outside the topology would
                 # shift every process id by one relative to the slices —
                 # the exact misalignment this ordering exists to prevent.
                 raise ValueError(
                     f"coordinator {coordinator_ip} is not in any slice"
                 )
+            n_coord = sum(
+                ips.count(coordinator_ip) for ips in slices.values()
+            )
+            if n_coord > 1:
+                # Silently stripping the extra occurrences would publish a
+                # slice smaller than discovery reported and shift the
+                # process-id -> slice mapping.
+                raise ValueError(
+                    f"coordinator {coordinator_ip} appears {n_coord} times "
+                    f"in the slice topology (slices {sorted(coord_slices)})"
+                )
+            coord_slice = coord_slices[0]
             names = sorted(slices, key=lambda g: (g != coord_slice, g))
             norm: dict[str, list[str]] = {}
             for g in names:

@@ -14,7 +14,6 @@ from deeplearning_cfn_tpu.models import bert
 from deeplearning_cfn_tpu.train.checkpoint import Checkpointer
 from deeplearning_cfn_tpu.train.data import SyntheticMLMDataset
 from deeplearning_cfn_tpu.examples.common import metrics_sink
-from deeplearning_cfn_tpu.train.metrics import ThroughputLogger
 from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
 
 
@@ -100,7 +99,15 @@ def main(argv: list[str] | None = None) -> dict:
         if restored is not None:
             state, start = restored
     _sink = metrics_sink(args, 'bert')
-    logger = ThroughputLogger(global_batch_size=batch, log_every=args.log_every, name="bert", sink=_sink)
+    logger = trainer.throughput_logger(
+        jnp.asarray(sample.x),
+        examples_per_step=batch,
+        name="bert",
+        sink=_sink,
+        log_every=args.log_every,
+        state=state,
+        sample_y=jnp.asarray(sample.y),
+    )
     state, losses = trainer.fit(
         state, batches(args.steps), steps=args.steps, logger=logger, checkpointer=ckpt
     )

@@ -26,7 +26,6 @@ from deeplearning_cfn_tpu.examples.common import (
 )
 from deeplearning_cfn_tpu.models import retinanet
 from deeplearning_cfn_tpu.train.data import SyntheticDetectionDataset
-from deeplearning_cfn_tpu.train.metrics import ThroughputLogger
 from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
 
 BACKBONES = {
@@ -139,9 +138,14 @@ def main(argv: list[str] | None = None) -> dict:
     batches = record_batches(args, batch) or ds.batches
     sample = next(iter(batches(1)))
     state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
-    logger = ThroughputLogger(
-        global_batch_size=batch, log_every=args.log_every, name="detection",
+    logger = trainer.throughput_logger(
+        jnp.asarray(sample.x),
+        examples_per_step=batch,
+        name="detection",
         sink=metrics_sink(args, "detection"),
+        log_every=args.log_every,
+        state=state,
+        sample_y=jax.tree_util.tree_map(jnp.asarray, sample.y),
     )
     state, losses = trainer.fit(
         state, batches(args.steps), steps=args.steps, logger=logger

@@ -21,7 +21,6 @@ from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
 from deeplearning_cfn_tpu.train.checkpoint import Checkpointer
 from deeplearning_cfn_tpu.train.data import SyntheticTokenDataset
 from deeplearning_cfn_tpu.examples.common import metrics_sink
-from deeplearning_cfn_tpu.train.metrics import ThroughputLogger
 from deeplearning_cfn_tpu.train.trainer import TrainerConfig
 
 
@@ -110,16 +109,14 @@ def main(argv: list[str] | None = None) -> dict:
         restored = ckpt.restore_latest(state)
         if restored is not None:
             state, _ = restored
-    _sink = metrics_sink(args, 'llama')
-    from deeplearning_cfn_tpu.train.metrics import peak_flops_per_chip
-
-    peak = peak_flops_per_chip()
-    logger = ThroughputLogger(
-        global_batch_size=batch * args.seq_len, log_every=args.log_every, name="llama", sink=_sink,
-        # Analytic 6N-based flops: the honest MFU numerator on
-        # flash-attention paths (cost_analysis can't see Pallas flops).
-        flops_per_step=llama.train_flops_per_token(cfg, args.seq_len) * batch * args.seq_len,
-        peak_flops=peak * n if peak else None,
+    # MFU numerator (analytic 6N — flash paths are invisible to cost
+    # analysis) is chosen centrally by the trainer.
+    logger = trainer.throughput_logger(
+        jnp.asarray(sample.x),
+        examples_per_step=batch * args.seq_len,  # tokens/sec
+        name="llama",
+        sink=metrics_sink(args, "llama"),
+        log_every=args.log_every,
     )
     state, losses = trainer.fit(
         state, batches(args.steps), steps=args.steps, logger=logger, checkpointer=ckpt

@@ -24,7 +24,6 @@ from deeplearning_cfn_tpu.examples.common import (
 )
 from deeplearning_cfn_tpu.models.resnet import ResNet50, ResNet101, ResNet152
 from deeplearning_cfn_tpu.train.data import SyntheticDataset
-from deeplearning_cfn_tpu.train.metrics import ThroughputLogger
 from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
 
 DEPTHS = {50: ResNet50, 101: ResNet101, 152: ResNet152}
@@ -66,24 +65,18 @@ def main(argv: list[str] | None = None) -> dict:
     )
     sample = next(iter(batches(1)))
     state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
-    # MFU from the compiled step's cost analysis (no Pallas ops in this
-    # model, so XLA's flop count is complete).  cost_analysis flops are
-    # PER-DEVICE under SPMD partitioning, so they pair with the
-    # per-chip peak — per-chip MFU at any mesh size.  The AOT compile
-    # populates the jit dispatch cache, so fit() does not recompile.
-    from deeplearning_cfn_tpu.train.metrics import peak_flops_per_chip
-
-    peak = peak_flops_per_chip()
-    flops = None
-    if peak:
-        x0 = jnp.asarray(sample.x)
-        y0 = jnp.asarray(sample.y)
-        flops = trainer.compile_stats(state, x0, y0).get("flops_per_step")
-    logger = ThroughputLogger(
-        global_batch_size=batch, log_every=args.log_every,
-        name=f"resnet{args.depth}", sink=metrics_sink(args, f"resnet{args.depth}"),
-        flops_per_step=flops,
-        peak_flops=peak,
+    # MFU numerator chosen centrally by the trainer: cost analysis here
+    # (no Pallas ops in this model, so XLA's flop count is complete); the
+    # AOT compile inside populates the jit dispatch cache, so fit() does
+    # not recompile.
+    logger = trainer.throughput_logger(
+        jnp.asarray(sample.x),
+        examples_per_step=batch,
+        name=f"resnet{args.depth}",
+        sink=metrics_sink(args, f"resnet{args.depth}"),
+        log_every=args.log_every,
+        state=state,
+        sample_y=jnp.asarray(sample.y),
     )
     state, losses = trainer.fit(state, batches(args.steps), steps=args.steps, logger=logger)
     result = {

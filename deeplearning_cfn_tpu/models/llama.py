@@ -454,6 +454,12 @@ def make_trainer(cfg: LlamaConfig, mesh: Mesh, trainer_config) -> Any:
         loss_fn=lambda p, x, y: causal_lm_loss(cfg, p, x, y, mesh),
         param_shardings=param_shardings(cfg, mesh),
         batch_spec=BATCH_SPEC,
+        # Analytic 6N numerator: flash attention runs in a Pallas custom
+        # call whose FLOPs XLA cost analysis cannot see, so every MFU
+        # consumer must use this instead (docs/BENCH_NOTES.md).
+        analytic_flops_fn=lambda x: (
+            train_flops_per_token(cfg, x.shape[1]) * x.shape[0] * x.shape[1]
+        ),
     )
 
 

@@ -370,10 +370,14 @@ def _clamp_block(block: int, seq: int) -> int:
     if not candidates:  # block < floor: honor the caller's small block
         return min(_round_up(block, _SUBLANE), seq_t)
     min_padded = min(p for _, p in candidates)
-    for b, padded in candidates:  # descending block size
-        if padded <= min_padded * (1.0 + _PAD_TOLERANCE):
-            return min(b, seq_t)
-    return min(candidates[-1][0], seq_t)
+    # Largest (descending order) candidate within tolerance of the best
+    # padding; the min_padded candidate itself always qualifies.
+    best = next(
+        b
+        for b, padded in candidates
+        if padded <= min_padded * (1.0 + _PAD_TOLERANCE)
+    )
+    return min(best, seq_t)
 
 
 def _core_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):

@@ -45,7 +45,10 @@ from deeplearning_cfn_tpu.parallel.sharding import (
     replicated,
 )
 from deeplearning_cfn_tpu.train.data import device_put_batch
-from deeplearning_cfn_tpu.train.metrics import ThroughputLogger
+from deeplearning_cfn_tpu.train.metrics import (
+    ThroughputLogger,
+    peak_flops_per_chip,
+)
 from deeplearning_cfn_tpu.utils.logging import get_logger
 
 log = get_logger("dlcfn.trainer")
@@ -141,6 +144,7 @@ class Trainer:
         batch_spec: P | None = None,
         stateful_loss_fn: Callable[..., tuple[jax.Array, tuple[dict, Any]]] | None = None,
         eval_loss_fn: Callable[..., tuple[jax.Array, dict]] | None = None,
+        analytic_flops_fn: Callable[[jax.Array], float] | None = None,
     ):
         self.model = model
         self.mesh = mesh
@@ -148,6 +152,13 @@ class Trainer:
         self.tx = _make_optimizer(config)
         self._custom_loss = loss_fn
         self._custom_stateful_loss = stateful_loss_fn
+        # analytic_flops_fn(global_batch_x) -> GLOBAL train flops per step.
+        # Models whose hot path runs inside Pallas custom calls (flash
+        # attention) MUST supply this: XLA cost analysis cannot see
+        # custom-call FLOPs, so every cost-analysis consumer would silently
+        # under-report MFU (docs/BENCH_NOTES.md).  compile_stats and
+        # throughput_logger prefer it whenever present.
+        self.analytic_flops_fn = analytic_flops_fn
         # eval_loss_fn(params, model_state, x, y) -> (loss, metrics): the
         # eval-mode counterpart of a custom stateful loss (train=False,
         # no mutation).
@@ -527,7 +538,13 @@ class Trainer:
         ``flops_per_step`` is PER-DEVICE for an SPMD-partitioned module
         (each device executes the partitioned program over its batch
         shard) — pair it with the per-chip peak for MFU.  The compile
-        populates the jit dispatch cache, so it is not paid twice."""
+        populates the jit dispatch cache, so it is not paid twice.
+
+        When the model supplies ``analytic_flops_fn``, ``flops_per_step``
+        is the analytic estimate (divided down to per-device scope) and
+        ``flops_source`` says so — XLA cost analysis excludes Pallas
+        custom-call FLOPs, so on flash-attention paths the raw cost
+        figure (still reported as ``cost_flops_per_step``) under-counts."""
         t0 = time.perf_counter()
         # Same mesh context as train_step: without it, in-model sharding
         # hints are dropped and this would measure (and compile) a different
@@ -536,11 +553,55 @@ class Trainer:
             lowered = self.step_fn.lower(state, x, y)
             compiled = lowered.compile()
         cost = compiled.cost_analysis() or {}
-        return {
+        out = {
             "compile_seconds": time.perf_counter() - t0,
-            "flops_per_step": cost.get("flops"),
+            "cost_flops_per_step": cost.get("flops"),
             "bytes_accessed": cost.get("bytes accessed"),
         }
+        if self.analytic_flops_fn is not None:
+            out["flops_per_step"] = self.analytic_flops_fn(x) / self.mesh.size
+            out["flops_source"] = "analytic"
+        else:
+            out["flops_per_step"] = cost.get("flops")
+            out["flops_source"] = "cost_analysis"
+        return out
+
+    def throughput_logger(
+        self,
+        sample_x: jax.Array,
+        examples_per_step: int,
+        *,
+        name: str = "train",
+        sink: Any = None,
+        log_every: int | None = None,
+        state: TrainState | None = None,
+        sample_y: jax.Array | None = None,
+    ) -> "ThroughputLogger":
+        """An MFU-correct ThroughputLogger for this trainer — the ONE place
+        the flops-numerator choice lives, so every consumer (examples,
+        ``dlcfn status`` via the metrics sink, bench harnesses) reports the
+        same MFU for the same run.  Prefers the model's analytic flops
+        (required for flash-attention paths); falls back to compiled cost
+        analysis when ``state``/``sample_y`` are given; otherwise logs
+        throughput without MFU.  Scope is per-chip on both sides:
+        per-device flops over per-chip peak."""
+        peak = peak_flops_per_chip()
+        flops = None
+        if peak is not None:
+            if self.analytic_flops_fn is not None:
+                flops = self.analytic_flops_fn(sample_x) / self.mesh.size
+            elif state is not None and sample_y is not None:
+                flops = self.compile_stats(state, sample_x, sample_y)[
+                    "flops_per_step"
+                ]
+        return ThroughputLogger(
+            global_batch_size=examples_per_step,
+            log_every=log_every if log_every is not None else self.config.log_every,
+            name=name,
+            sink=sink,
+            flops_per_step=flops,
+            peak_flops=peak,
+        )
 
 
 @dataclass

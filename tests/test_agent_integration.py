@@ -97,6 +97,7 @@ def _spawn_agent(env: dict[str, str]) -> subprocess.Popen:
     )
 
 
+@pytest.mark.smoke
 def test_remote_bootstrap_end_to_end(broker, tmp_path):
     template = _write_template(tmp_path)
     vm_roots = [tmp_path / f"vm{i}" for i in range(WORKERS)]

@@ -6,6 +6,8 @@ storage retention.
 
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 from deeplearning_cfn_tpu.cluster.bootstrap import cluster_ready_resource
 from deeplearning_cfn_tpu.cluster.contract import ClusterContract
 from deeplearning_cfn_tpu.config.schema import ClusterSpec, JobSpec, NodePool, StorageSpec, TimeoutSpec

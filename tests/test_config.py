@@ -7,6 +7,8 @@ launcher invariants (run.sh:43-44, run.sh:56-66).
 
 import pytest
 
+pytestmark = pytest.mark.smoke
+
 from deeplearning_cfn_tpu.config.schema import (
     ClusterSpec,
     ConfigError,

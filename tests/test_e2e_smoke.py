@@ -40,6 +40,7 @@ E2E_TEMPLATE = {
 }
 
 
+@pytest.mark.smoke
 def test_template_to_training_smoke(contract_root):
     # 1. Template -> spec
     spec = render_template(E2E_TEMPLATE)

@@ -205,6 +205,14 @@ def test_contract_rejects_inconsistent_slice_topology():
             workers=["10.0.0.2", "10.0.0.9"],
             slices={"s0": ["10.0.0.2", "10.0.0.9"], "s1": ["10.0.0.7"]},
         )
+    with pytest.raises(ValueError, match="appears 2 times"):
+        build(
+            workers=["10.0.0.2", "10.0.0.3", "10.0.0.9"],
+            slices={
+                "s0": ["10.0.0.2", "10.0.0.3"],
+                "s1": ["10.0.0.2", "10.0.0.9"],
+            },
+        )
 
 
 def test_hybrid_mesh_for_slices():

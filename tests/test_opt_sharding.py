@@ -64,6 +64,7 @@ def llama_state():
     return trainer, state
 
 
+@pytest.mark.smoke
 def test_llama_adam_moments_shardings_equal_params(llama_state):
     _, state = llama_state
     n_params = len(jax.tree_util.tree_leaves(state.params))

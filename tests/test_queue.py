@@ -5,6 +5,9 @@ The three SQS behaviors the reference's choreography depends on
 broadcast-without-delete trick (dl_cfn_setup_v2.py:180-190).
 """
 
+import pytest
+
+pytestmark = pytest.mark.smoke
 from deeplearning_cfn_tpu.cluster.queue import InMemoryQueue
 from deeplearning_cfn_tpu.utils.timeouts import FakeClock
 
