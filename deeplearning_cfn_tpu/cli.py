@@ -64,8 +64,39 @@ def _parse_broker(broker: str) -> tuple[str, int]:
     except ValueError:
         port = -1
     if not host or not (0 < port < 65536):
-        raise SystemExit(f"--broker expects HOST:PORT, got {broker!r}")
+        raise SystemExit(f"--broker expects HOST:PORT or 'auto', got {broker!r}")
     return host, port
+
+
+def _resolve_broker(spec: ClusterSpec, args) -> str | None:
+    """Resolve --broker, provisioning the broker itself for ``auto`` — the
+    control plane is a stack resource (deeplearning.template:743-754), not
+    an operator-managed prerequisite.  Returns HOST:PORT or None."""
+    broker = getattr(args, "broker", None)
+    if broker != "auto":
+        return broker
+    from deeplearning_cfn_tpu.cluster.broker_client import BrokerError
+    from deeplearning_cfn_tpu.cluster.broker_service import (
+        detect_host_ip,
+        ensure_broker,
+    )
+
+    advertise = getattr(args, "broker_advertise", None)
+    if advertise is None:
+        # Loopback for the in-process dev backend; a routable address for
+        # real clusters (TPU VMs must dial back to this host).
+        advertise = "127.0.0.1" if spec.backend == "local" else detect_host_ip()
+    try:
+        host, port, started = ensure_broker(spec.name, advertise=advertise)
+    except (BrokerError, OSError) as e:
+        # OSError: e.g. no write access to $DLCFN_ROOT for the record.
+        raise SystemExit(f"broker provisioning failed: {e}") from e
+    print(
+        f"broker for {spec.name!r}: {host}:{port} "
+        f"({'started' if started else 'reused'})",
+        file=sys.stderr,
+    )
+    return f"{host}:{port}"
 
 
 def _backend_for(spec: ClusterSpec, broker: str | None = None):
@@ -134,7 +165,7 @@ def cmd_create(args) -> int:
     from deeplearning_cfn_tpu.provision.provisioner import ProvisionFailure, Provisioner
 
     spec = _load_spec(args)
-    broker = getattr(args, "broker", None)
+    broker = _resolve_broker(spec, args)
     backend = _backend_for(spec, broker)
     prov = Provisioner(
         backend,
@@ -185,12 +216,16 @@ def cmd_describe(args) -> int:
 
 
 def cmd_delete(args) -> int:
+    from deeplearning_cfn_tpu.cluster.broker_service import teardown_broker
     from deeplearning_cfn_tpu.provision.provisioner import Provisioner
 
     spec = _load_spec(args)
     backend = _backend_for(spec)
     prov = Provisioner(backend, spec)
     out = prov.delete(force_storage=args.force_storage)
+    # The broker is a stack resource: delete tears it down with the
+    # cluster (a no-op when none was auto-provisioned).
+    out.update(teardown_broker(spec.name))
     print(json.dumps(out, indent=2))
     return 0
 
@@ -202,7 +237,7 @@ def cmd_recover(args) -> int:
     from deeplearning_cfn_tpu.provision.provisioner import ProvisionFailure, Provisioner
 
     spec = _load_spec(args)
-    broker = getattr(args, "broker", None)
+    broker = _resolve_broker(spec, args)
     backend = _backend_for(spec, broker)
     prov = Provisioner(
         backend, spec, remote_agents=bool(broker), progress=_progress_printer
@@ -473,7 +508,7 @@ def cmd_run(args) -> int:
 
     t0 = time.monotonic()
     spec = _load_spec(args)
-    broker = getattr(args, "broker", None)
+    broker = _resolve_broker(spec, args)
     backend = _backend_for(spec, broker)
     prov = Provisioner(
         backend, spec, remote_agents=bool(broker), progress=_progress_printer
@@ -576,9 +611,20 @@ def main(argv: list[str] | None = None) -> int:
             p.add_argument(
                 "--broker",
                 default=None,
-                metavar="HOST:PORT",
+                metavar="HOST:PORT|auto",
                 help="rendezvous broker address; bootstrap agents run on the "
-                "VMs (production topology) instead of inline",
+                "VMs (production topology) instead of inline.  'auto' "
+                "provisions the broker as part of the stack (detached on "
+                "this host, torn down by delete)",
+            )
+            p.add_argument(
+                "--broker-advertise",
+                default=None,
+                dest="broker_advertise",
+                metavar="HOST",
+                help="with --broker auto: the address VMs dial (default: "
+                "loopback for the local backend, this host's routable IP "
+                "otherwise)",
             )
         if name == "run":
             p.add_argument(

@@ -1,0 +1,267 @@
+"""The rendezvous broker as a STACK RESOURCE.
+
+The reference's control-plane queues are CloudFormation resources — created
+with the stack, deleted with it, never a manual pre-step
+(deeplearning.template:743-754).  Round 2 shipped the broker binary and the
+agents that dial it, but ``create`` still assumed an operator had started a
+broker somewhere routable.  This module closes that gap: ``dlcfn create
+--broker auto`` (and run/recover) calls :func:`ensure_broker`, which
+
+- reuses a live broker previously recorded for this cluster (idempotent,
+  like CloudFormation's no-op update for an unchanged resource),
+- otherwise builds + spawns ``native/broker/dlcfn-broker`` as a DETACHED
+  process that outlives the CLI (the stack outlives ``create``),
+- health-checks it (PING) before any queued-resource creation happens, and
+- records ``{host, port, pid}`` under the contract root so ``dlcfn
+  delete`` can tear it down with the cluster (:func:`teardown_broker`).
+
+Topology: the broker runs on the operator/controller host — the GCE-VM
+analog of the reference's regional SQS endpoint — and its address is
+stamped into TPU VM metadata exactly as an explicit ``--broker HOST:PORT``
+would be (provision/gcp.py broker_host).  ``advertise`` selects the address
+written to the record: loopback for the local/dev backend, this host's
+routable IP (or an explicit override) for real clusters.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import time
+from pathlib import Path
+
+from deeplearning_cfn_tpu.cluster.broker_client import (
+    BROKER_BIN,
+    BrokerConnection,
+    BrokerError,
+    build_broker,
+)
+from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+from deeplearning_cfn_tpu.utils.logging import get_logger
+
+log = get_logger("dlcfn.broker")
+
+_LISTENING = re.compile(r"listening on (\d+)")
+
+
+def _record_path(cluster_name: str, root: Path | None = None) -> Path:
+    root = root or ClusterContract.root_dir()
+    return root / "broker" / f"{cluster_name}.json"
+
+
+def detect_host_ip() -> str:
+    """This host's outbound IP — the address a TPU VM would dial.  The
+    UDP-connect trick never sends a packet; the fallback is loopback
+    (dev boxes with no route)."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+def _alive(host: str, port: int, timeout_s: float = 2.0) -> bool:
+    try:
+        conn = BrokerConnection(host, port, timeout_s=timeout_s)
+        try:
+            return conn.ping()
+        finally:
+            conn.close()
+    except (OSError, BrokerError):
+        return False
+
+
+def broker_status(cluster_name: str, root: Path | None = None) -> dict | None:
+    """The recorded broker for a cluster, plus liveness — or None."""
+    rec = _record_path(cluster_name, root)
+    try:
+        data = json.loads(rec.read_text())
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+    data["alive"] = _alive(data["host"], int(data["port"]))
+    return data
+
+
+def ensure_broker(
+    cluster_name: str,
+    root: Path | None = None,
+    advertise: str | None = None,
+    port: int = 0,
+    timeout_s: float = 30.0,
+) -> tuple[str, int, bool]:
+    """Return ``(host, port, started)`` for a live broker serving this
+    cluster, starting one (detached) if none is recorded and reachable."""
+    rec = _record_path(cluster_name, root)
+    existing = broker_status(cluster_name, root)
+    if existing is not None:
+        if existing["alive"]:
+            host = existing["host"]
+            if advertise is not None and advertise != host:
+                # The broker binds all interfaces; the record's host is
+                # only the address VMs dial.  An operator re-running with
+                # a (corrected) advertise address must not be silently
+                # held to the old one.
+                log.warning(
+                    "rewriting broker advertise address for %s: %s -> %s",
+                    cluster_name, host, advertise,
+                )
+                existing["host"] = host = advertise
+                rec.write_text(
+                    json.dumps({k: v for k, v in existing.items() if k != "alive"})
+                )
+            log.info(
+                "reusing broker for %s at %s:%s (pid %s)",
+                cluster_name, host, existing["port"], existing["pid"],
+            )
+            return host, int(existing["port"]), False
+        log.warning(
+            "recorded broker for %s at %s:%s is dead; starting a new one",
+            cluster_name, existing["host"], existing["port"],
+        )
+        rec.unlink(missing_ok=True)
+
+    build_broker()
+    rec.parent.mkdir(parents=True, exist_ok=True)
+    log_path = rec.with_suffix(".log")
+    # Exclusive-create lock: two concurrent ensure calls (parallel create +
+    # run) must not each spawn a detached broker — the loser's process
+    # would be leaked with no record pointing at it.
+    lock = rec.with_suffix(".lock")
+    try:
+        fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        os.write(fd, str(os.getpid()).encode())
+        os.close(fd)
+    except FileExistsError:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            st = broker_status(cluster_name, root)
+            if st is not None and st["alive"]:
+                return st["host"], int(st["port"]), False
+            time.sleep(0.1)
+        raise BrokerError(
+            f"another process holds {lock} but never published a live "
+            "broker; remove the lock if it is stale"
+        )
+    try:
+        # "wb": a crashed broker's log would otherwise leave a stale
+        # "listening on <port>" line that the parser below would match
+        # first, pointing every restart at the dead port.
+        log_fh = open(log_path, "wb")
+        try:
+            # start_new_session: the broker is a stack resource that must
+            # survive this CLI process (and its process group / terminal).
+            proc = subprocess.Popen(
+                [str(BROKER_BIN), str(port)],
+                stdout=log_fh,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+        finally:
+            log_fh.close()
+
+        # The broker prints "dlcfn-broker listening on <port>" first; poll
+        # the log for it (stdout is detached), then health-check with PING.
+        deadline = time.monotonic() + timeout_s
+        bound_port: int | None = None
+        while time.monotonic() < deadline and bound_port is None:
+            if proc.poll() is not None:
+                raise BrokerError(
+                    f"broker exited with {proc.returncode} at startup; "
+                    f"see {log_path}"
+                )
+            m = _LISTENING.search(log_path.read_text(errors="replace"))
+            if m:
+                bound_port = int(m.group(1))
+                break
+            time.sleep(0.05)
+        if bound_port is None:
+            proc.terminate()
+            raise BrokerError(f"broker did not report a port; see {log_path}")
+        while time.monotonic() < deadline:
+            if _alive("127.0.0.1", bound_port):
+                break
+            time.sleep(0.05)
+        else:
+            proc.terminate()
+            raise BrokerError("broker did not become reachable")
+
+        host = advertise or "127.0.0.1"
+        rec.write_text(
+            json.dumps(
+                {
+                    "cluster": cluster_name,
+                    "host": host,
+                    "port": bound_port,
+                    "pid": proc.pid,
+                    "started_ts": time.time(),
+                }
+            )
+        )
+    finally:
+        lock.unlink(missing_ok=True)
+    log.info(
+        "started broker for %s at %s:%d (pid %d, log %s)",
+        cluster_name, host, bound_port, proc.pid, log_path,
+    )
+    return host, bound_port, True
+
+
+def teardown_broker(cluster_name: str, root: Path | None = None) -> dict:
+    """Stop and forget the cluster's recorded broker (``delete``'s side of
+    the stack-resource contract).  Safe when none exists."""
+    rec = _record_path(cluster_name, root)
+    status = broker_status(cluster_name, root)
+    if status is None:
+        return {"broker": "none"}
+    pid = int(status["pid"])
+
+    def gone() -> bool:
+        # Reap first if the broker is OUR child (ensure_broker ran in this
+        # process): a terminated-but-unreaped child still answers kill(0).
+        # Cross-process (create in one CLI, delete in another) the broker
+        # was adopted and reaped by init, so kill(0) alone is accurate.
+        try:
+            if os.waitpid(pid, os.WNOHANG)[0] == pid:
+                return True
+        except ChildProcessError:
+            pass  # not our child
+        try:
+            os.kill(pid, 0)
+            return False
+        except ProcessLookupError:
+            return True
+
+    stopped = False
+    try:
+        os.kill(pid, signal.SIGTERM)
+        for _ in range(50):
+            if gone():
+                stopped = True
+                break
+            time.sleep(0.1)
+        if not stopped:
+            os.kill(pid, signal.SIGKILL)
+            for _ in range(50):
+                if gone():
+                    break
+                time.sleep(0.1)
+            stopped = True
+    except ProcessLookupError:
+        stopped = True  # already gone
+    except PermissionError:
+        # Someone else's pid (stale record reused by the OS): do not kill.
+        stopped = False
+    rec.unlink(missing_ok=True)
+    rec.with_suffix(".log").unlink(missing_ok=True)
+    rec.with_suffix(".lock").unlink(missing_ok=True)
+    return {
+        "broker": "stopped" if stopped else "left-running",
+        "host": status["host"],
+        "port": status["port"],
+        "pid": pid,
+    }

@@ -1,0 +1,153 @@
+"""Broker-as-stack-resource lifecycle (cluster/broker_service.py).
+
+The reference's control-plane queues are template resources created and
+deleted with the stack (deeplearning.template:743-754); ensure/teardown
+reproduce that lifecycle for the native broker.
+"""
+
+import json
+import os
+import shutil
+
+import pytest
+
+from deeplearning_cfn_tpu.cluster.broker_service import (
+    broker_status,
+    ensure_broker,
+    teardown_broker,
+)
+
+pytestmark = [
+    pytest.mark.smoke,
+    pytest.mark.skipif(
+        shutil.which("g++") is None or shutil.which("make") is None,
+        reason="native toolchain unavailable",
+    ),
+]
+
+
+def test_ensure_reuse_teardown_cycle(tmp_path):
+    host, port, started = ensure_broker("svc", root=tmp_path)
+    try:
+        assert started is True
+        assert host == "127.0.0.1"
+        status = broker_status("svc", root=tmp_path)
+        assert status is not None and status["alive"] is True
+
+        # Idempotent: a second ensure reuses the live broker.
+        host2, port2, started2 = ensure_broker("svc", root=tmp_path)
+        assert (host2, port2, started2) == (host, port, False)
+    finally:
+        out = teardown_broker("svc", root=tmp_path)
+    assert out["broker"] == "stopped"
+    assert broker_status("svc", root=tmp_path) is None
+    # The pid is really gone.
+    with pytest.raises(ProcessLookupError):
+        os.kill(int(out["pid"]), 0)
+
+
+def test_stale_record_is_replaced(tmp_path):
+    rec = tmp_path / "broker" / "svc.json"
+    rec.parent.mkdir(parents=True)
+    # A dead broker: valid record shape, nothing listening.
+    rec.write_text(
+        json.dumps({"cluster": "svc", "host": "127.0.0.1", "port": 1, "pid": 1})
+    )
+    host, port, started = ensure_broker("svc", root=tmp_path)
+    try:
+        assert started is True
+        assert port != 1
+    finally:
+        teardown_broker("svc", root=tmp_path)
+
+
+def test_restart_after_crash_ignores_stale_log(tmp_path):
+    """A crashed broker leaves a log whose 'listening on <port>' line must
+    not be mistaken for the NEW broker's port on restart (the log is
+    truncated on spawn, not appended)."""
+    rec = tmp_path / "broker" / "svc.json"
+    rec.parent.mkdir(parents=True)
+    rec.write_text(
+        json.dumps({"cluster": "svc", "host": "127.0.0.1", "port": 1, "pid": 1})
+    )
+    rec.with_suffix(".log").write_text("dlcfn-broker listening on 1\n")
+    host, port, started = ensure_broker("svc", root=tmp_path)
+    try:
+        assert started is True
+        assert port != 1
+        assert broker_status("svc", root=tmp_path)["alive"] is True
+    finally:
+        teardown_broker("svc", root=tmp_path)
+
+
+def test_reuse_rewrites_advertise_address(tmp_path):
+    """Re-running with a different --broker-advertise must take effect on
+    a live reused broker (the recorded host is only what VMs dial)."""
+    _, port, _ = ensure_broker("svc", root=tmp_path, advertise="127.0.0.1")
+    try:
+        host2, port2, started2 = ensure_broker(
+            "svc", root=tmp_path, advertise="10.9.9.9"
+        )
+        assert (host2, port2, started2) == ("10.9.9.9", port, False)
+        rec = json.loads((tmp_path / "broker" / "svc.json").read_text())
+        assert rec["host"] == "10.9.9.9"
+    finally:
+        teardown_broker("svc", root=tmp_path)
+
+
+def test_concurrent_ensure_waits_on_lock(tmp_path):
+    """A held lock makes the second caller wait for the first's record
+    instead of spawning a duplicate (leaked) broker."""
+    import threading
+    import time as _time
+
+    lock = tmp_path / "broker" / "svc.lock"
+    lock.parent.mkdir(parents=True)
+    lock.write_text("123")
+    results = {}
+
+    def second():
+        results["out"] = ensure_broker("svc", root=tmp_path, timeout_s=10)
+
+    t = threading.Thread(target=second)
+    t.start()
+    _time.sleep(0.3)
+    # First caller publishes its record and releases the lock.
+    host, port, _ = ensure_broker("first", root=tmp_path)
+    try:
+        rec = tmp_path / "broker" / "svc.json"
+        rec.write_text(
+            json.dumps(
+                {"cluster": "svc", "host": "127.0.0.1", "port": port,
+                 "pid": json.loads((tmp_path / "broker" / "first.json").read_text())["pid"]}
+            )
+        )
+        lock.unlink()
+        t.join(timeout=15)
+        assert not t.is_alive()
+        assert results["out"] == ("127.0.0.1", port, False)
+    finally:
+        teardown_broker("first", root=tmp_path)
+        (tmp_path / "broker" / "svc.json").unlink(missing_ok=True)
+
+
+def test_teardown_without_record_is_noop(tmp_path):
+    assert teardown_broker("none", root=tmp_path) == {"broker": "none"}
+
+
+def test_advertise_address_is_recorded(tmp_path):
+    host, port, _ = ensure_broker("adv", root=tmp_path, advertise="10.1.2.3")
+    try:
+        assert host == "10.1.2.3"
+        rec = json.loads((tmp_path / "broker" / "adv.json").read_text())
+        assert rec["host"] == "10.1.2.3"
+        # Liveness probing must still work against the advertised address
+        # being unroutable from here?  No: status probes the recorded host,
+        # so an unroutable advertise reads as dead from THIS machine — the
+        # operator host always advertises an address routable to itself in
+        # practice (loopback or its own IP).  Probe via loopback instead.
+        from deeplearning_cfn_tpu.cluster.broker_service import _alive
+
+        assert _alive("127.0.0.1", port)
+    finally:
+        teardown_broker("adv", root=tmp_path)
