@@ -99,7 +99,63 @@ def _resolve_broker(spec: ClusterSpec, args) -> str | None:
     return f"{host}:{port}"
 
 
-def _backend_for(spec: ClusterSpec, broker: str | None = None):
+class _DryRun:
+    """--print-requests state for one lifecycle command: a recording
+    transport over fake responses, a throwaway contract root, and the
+    transcript emission — one implementation shared by all four commands
+    so their dry-run behavior cannot drift."""
+
+    def __init__(self, spec: ClusterSpec, broker: str | None):
+        import tempfile
+
+        if spec.backend != "gcp":
+            raise SystemExit(
+                "--print-requests is only meaningful for backend 'gcp'"
+            )
+        if broker:
+            raise SystemExit(
+                "--print-requests dry-runs inline (no VMs, no broker); "
+                "drop --broker"
+            )
+        from deeplearning_cfn_tpu.provision.gcp import (
+            FakeGCPTransport,
+            RecordingTransport,
+        )
+
+        self.recorder = RecordingTransport(
+            FakeGCPTransport(workers=spec.pool.num_workers, provision_polls=1),
+            project=spec.project or "example-project",
+        )
+        self._tmp = tempfile.TemporaryDirectory(prefix="dlcfn-dryrun-")
+        self.contract_root = Path(self._tmp.name)
+
+    def seed(self, backend, spec: ClusterSpec):
+        """Provision into the fake first (requests discarded) so describe/
+        delete transcripts show the wire protocol against an EXISTING
+        cluster — what those ops actually do in production — and return
+        the seeded provisioner."""
+        from deeplearning_cfn_tpu.provision.provisioner import Provisioner
+
+        prov = Provisioner(backend, spec, contract_root=self.contract_root)
+        prov.provision()
+        self.recorder.requests.clear()
+        return prov
+
+    def emit(self, op: str) -> int:
+        print(
+            json.dumps({"op": op, "requests": self.recorder.requests}, indent=2)
+        )
+        self._tmp.cleanup()
+        return 0
+
+
+def _maybe_dryrun(args, spec: ClusterSpec) -> "_DryRun | None":
+    if not getattr(args, "print_requests", False):
+        return None
+    return _DryRun(spec, getattr(args, "broker", None))
+
+
+def _backend_for(spec: ClusterSpec, broker: str | None = None, recorder=None):
     broker_addr = _parse_broker(broker) if broker else None
     if spec.backend == "local":
         from deeplearning_cfn_tpu.provision.local import LocalBackend
@@ -109,6 +165,13 @@ def _backend_for(spec: ClusterSpec, broker: str | None = None):
         from deeplearning_cfn_tpu.cluster.startup import render_startup_script
         from deeplearning_cfn_tpu.provision.gcp import GCPBackend
 
+        extra = {}
+        if recorder is not None:
+            from deeplearning_cfn_tpu.utils.timeouts import FakeClock
+
+            # Dry-run: recorded fake transport + an instant clock (the
+            # 30 s-style poll sleeps would otherwise run on wallclock).
+            extra = {"transport": recorder, "clock": FakeClock()}
         backend = GCPBackend(
             project=spec.project,
             zone=spec.zone,
@@ -125,6 +188,7 @@ def _backend_for(spec: ClusterSpec, broker: str | None = None):
             # script can hand agents their control plane.
             broker_host=broker_addr[0] if broker_addr else None,
             broker_port=broker_addr[1] if broker_addr else 8477,
+            **extra,
         )
     if broker_addr:
         # Production topology: agents run on the VMs and rendezvous through
@@ -165,13 +229,16 @@ def cmd_create(args) -> int:
     from deeplearning_cfn_tpu.provision.provisioner import ProvisionFailure, Provisioner
 
     spec = _load_spec(args)
-    broker = _resolve_broker(spec, args)
-    backend = _backend_for(spec, broker)
+    dry = _maybe_dryrun(args, spec)
+    broker = None if dry else _resolve_broker(spec, args)
+    backend = _backend_for(spec, broker, recorder=dry.recorder if dry else None)
     prov = Provisioner(
         backend,
         spec,
         remote_agents=bool(broker),
         progress=_progress_printer,
+        # Dry runs must not touch the real contract dir.
+        contract_root=dry.contract_root if dry else None,
     )
     t0 = time.monotonic()
     print(f"creating cluster {spec.name!r}...", file=sys.stderr)
@@ -182,6 +249,8 @@ def cmd_create(args) -> int:
     except ProvisionFailure as e:
         print(f"CREATE FAILED after {time.monotonic() - t0:.0f}s: {e}", file=sys.stderr)
         return 1
+    if dry is not None:
+        return dry.emit("create")
     elapsed = time.monotonic() - t0
     print(
         json.dumps(
@@ -204,13 +273,21 @@ def cmd_describe(args) -> int:
     from deeplearning_cfn_tpu.provision.provisioner import Provisioner
 
     spec = _load_spec(args)
-    backend = _backend_for(spec)
+    dry = _maybe_dryrun(args, spec)
+    backend = _backend_for(spec, recorder=dry.recorder if dry else None)
+    if dry is not None:
+        # Seed a cluster into the fake, then describe from a FRESH
+        # provisioner — the post-crash/fresh-process path (group-record
+        # adoption + TPU API reads), the sequence a real describe issues.
+        dry.seed(backend, spec)
     prov = Provisioner(backend, spec)
     try:
         desc = prov.describe()
     except KeyError:
         print(f"cluster {spec.name!r} not found on this backend", file=sys.stderr)
         return 1
+    if dry is not None:
+        return dry.emit("describe")
     print(json.dumps(desc, indent=2))
     return 0
 
@@ -220,9 +297,17 @@ def cmd_delete(args) -> int:
     from deeplearning_cfn_tpu.provision.provisioner import Provisioner
 
     spec = _load_spec(args)
-    backend = _backend_for(spec)
-    prov = Provisioner(backend, spec)
+    dry = _maybe_dryrun(args, spec)
+    backend = _backend_for(spec, recorder=dry.recorder if dry else None)
+    if dry is not None:
+        # Seeded provisioner: delete of an EXISTING cluster, including the
+        # storage retain/delete decision — the real production sequence.
+        prov = dry.seed(backend, spec)
+    else:
+        prov = Provisioner(backend, spec)
     out = prov.delete(force_storage=args.force_storage)
+    if dry is not None:
+        return dry.emit("delete")
     # The broker is a stack resource: delete tears it down with the
     # cluster (a no-op when none was auto-provisioned).
     out.update(teardown_broker(spec.name))
@@ -237,10 +322,15 @@ def cmd_recover(args) -> int:
     from deeplearning_cfn_tpu.provision.provisioner import ProvisionFailure, Provisioner
 
     spec = _load_spec(args)
-    broker = _resolve_broker(spec, args)
-    backend = _backend_for(spec, broker)
+    dry = _maybe_dryrun(args, spec)
+    broker = None if dry else _resolve_broker(spec, args)
+    backend = _backend_for(spec, broker, recorder=dry.recorder if dry else None)
     prov = Provisioner(
-        backend, spec, remote_agents=bool(broker), progress=_progress_printer
+        backend,
+        spec,
+        remote_agents=bool(broker),
+        progress=_progress_printer,
+        contract_root=dry.contract_root if dry else None,
     )
     t0 = time.monotonic()
     print(f"recovering cluster {spec.name!r}...", file=sys.stderr)
@@ -249,6 +339,8 @@ def cmd_recover(args) -> int:
     except ProvisionFailure as e:
         print(f"RECOVER FAILED after {time.monotonic() - t0:.0f}s: {e}", file=sys.stderr)
         return 1
+    if dry is not None:
+        return dry.emit("recover")
     print(
         json.dumps(
             {
@@ -636,6 +728,17 @@ def main(argv: list[str] | None = None) -> int:
                 help="on instance loss, recreate the cluster (reusing "
                 "retained storage) and rerun the job, up to N times; the "
                 "job resumes from its checkpoints",
+            )
+        if name in ("create", "describe", "delete", "recover"):
+            p.add_argument(
+                "--print-requests",
+                action="store_true",
+                dest="print_requests",
+                help="dry-run (gcp backend): drive the full flow against "
+                "recorded fake responses and print the exact ordered HTTP "
+                "requests (method, resolved URL, body) the real Google "
+                "APIs would receive — reviewable against the public API "
+                "docs without a network",
             )
         if name == "delete":
             p.add_argument("--force-storage", action="store_true")

@@ -77,13 +77,18 @@ def _alive(host: str, port: int, timeout_s: float = 2.0) -> bool:
 
 
 def broker_status(cluster_name: str, root: Path | None = None) -> dict | None:
-    """The recorded broker for a cluster, plus liveness — or None."""
+    """The recorded broker for a cluster, plus liveness — or None.
+
+    Liveness is probed on LOOPBACK: the broker always runs on this host
+    (it binds all interfaces); the recorded ``host`` is only the address
+    VMs dial, which may be a NAT/public IP not locally routable — probing
+    it would misread a live broker as dead and spawn a leaked duplicate."""
     rec = _record_path(cluster_name, root)
     try:
         data = json.loads(rec.read_text())
     except (FileNotFoundError, json.JSONDecodeError):
         return None
-    data["alive"] = _alive(data["host"], int(data["port"]))
+    data["alive"] = _alive("127.0.0.1", int(data["port"]))
     return data
 
 
@@ -142,6 +147,30 @@ def ensure_broker(
             st = broker_status(cluster_name, root)
             if st is not None and st["alive"]:
                 return st["host"], int(st["port"]), False
+            # Stale-lock reclaim: the holder wrote its pid for exactly
+            # this check — a crash between lock and unlink must not brick
+            # --broker auto until manual cleanup.
+            try:
+                holder = int(lock.read_text().strip() or 0)
+            except (FileNotFoundError, ValueError):
+                holder = 0
+            holder_alive = False
+            if holder:
+                try:
+                    os.kill(holder, 0)
+                    holder_alive = True
+                except (ProcessLookupError, PermissionError):
+                    holder_alive = False
+            if holder and not holder_alive:
+                log.warning(
+                    "reclaiming stale broker lock %s (holder pid %d is dead)",
+                    lock, holder,
+                )
+                lock.unlink(missing_ok=True)
+                return ensure_broker(
+                    cluster_name, root=root, advertise=advertise, port=port,
+                    timeout_s=max(deadline - time.monotonic(), 5.0),
+                )
             time.sleep(0.1)
         raise BrokerError(
             f"another process holds {lock} but never published a live "
@@ -219,6 +248,24 @@ def teardown_broker(cluster_name: str, root: Path | None = None) -> dict:
     if status is None:
         return {"broker": "none"}
     pid = int(status["pid"])
+
+    # Never SIGTERM a recycled pid: after a reboot the record survives but
+    # the OS may have reassigned the pid to an unrelated same-user
+    # process.  Only kill when the pid's cmdline is actually the broker.
+    try:
+        cmdline = Path(f"/proc/{pid}/cmdline").read_bytes().decode(errors="replace")
+    except OSError:
+        cmdline = ""
+    if "dlcfn-broker" not in cmdline:
+        rec.unlink(missing_ok=True)
+        rec.with_suffix(".log").unlink(missing_ok=True)
+        rec.with_suffix(".lock").unlink(missing_ok=True)
+        return {
+            "broker": "stale-record",
+            "host": status["host"],
+            "port": status["port"],
+            "pid": pid,
+        }
 
     def gone() -> bool:
         # Reap first if the broker is OUR child (ensure_broker ran in this

@@ -103,7 +103,9 @@ def test_concurrent_ensure_waits_on_lock(tmp_path):
 
     lock = tmp_path / "broker" / "svc.lock"
     lock.parent.mkdir(parents=True)
-    lock.write_text("123")
+    # Holder = THIS (live) process; a dead holder pid would trigger the
+    # stale-reclaim path instead (tested separately below).
+    lock.write_text(str(os.getpid()))
     results = {}
 
     def second():
@@ -129,6 +131,45 @@ def test_concurrent_ensure_waits_on_lock(tmp_path):
     finally:
         teardown_broker("first", root=tmp_path)
         (tmp_path / "broker" / "svc.json").unlink(missing_ok=True)
+
+
+def test_stale_lock_from_dead_holder_is_reclaimed(tmp_path):
+    """A crash between lock-create and unlink must not brick --broker
+    auto: a lock whose recorded holder pid is dead is reclaimed and the
+    broker starts normally."""
+    lock = tmp_path / "broker" / "svc.lock"
+    lock.parent.mkdir(parents=True)
+    # Spawn-and-reap a child so its pid is known-dead.
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    lock.write_text(str(proc.pid))
+    host, port, started = ensure_broker("svc", root=tmp_path, timeout_s=15)
+    try:
+        assert started is True
+        assert broker_status("svc", root=tmp_path)["alive"] is True
+    finally:
+        teardown_broker("svc", root=tmp_path)
+
+
+def test_teardown_stale_record_does_not_kill_recycled_pid(tmp_path):
+    """After a reboot the record can point at a recycled pid belonging to
+    an unrelated process; teardown must verify the cmdline is actually
+    dlcfn-broker before signalling."""
+    rec = tmp_path / "broker" / "svc.json"
+    rec.parent.mkdir(parents=True)
+    rec.write_text(
+        json.dumps(
+            {"cluster": "svc", "host": "127.0.0.1", "port": 1,
+             "pid": os.getpid()}  # a live pid that is NOT a broker
+        )
+    )
+    out = teardown_broker("svc", root=tmp_path)
+    assert out["broker"] == "stale-record"
+    os.kill(os.getpid(), 0)  # we are demonstrably still alive
+    assert broker_status("svc", root=tmp_path) is None
 
 
 def test_teardown_without_record_is_noop(tmp_path):

