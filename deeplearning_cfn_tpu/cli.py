@@ -188,6 +188,7 @@ def _backend_for(spec: ClusterSpec, broker: str | None = None, recorder=None):
             # script can hand agents their control plane.
             broker_host=broker_addr[0] if broker_addr else None,
             broker_port=broker_addr[1] if broker_addr else 8477,
+            storage_namespace=spec.name,
             **extra,
         )
     if broker_addr:

@@ -159,14 +159,27 @@ def ensure_broker(
                 try:
                     os.kill(holder, 0)
                     holder_alive = True
-                except (ProcessLookupError, PermissionError):
+                except ProcessLookupError:
                     holder_alive = False
+                except PermissionError:
+                    # EPERM = the pid EXISTS under another user — alive.
+                    holder_alive = True
             if holder and not holder_alive:
+                # Atomic reclaim: rename wins exactly once, so two waiters
+                # observing the same dead holder cannot both proceed (the
+                # loser's rename fails and it keeps waiting for the
+                # winner's record).
+                stale = lock.with_suffix(".stale")
+                try:
+                    os.rename(lock, stale)
+                except (FileNotFoundError, OSError):
+                    time.sleep(0.1)
+                    continue
+                stale.unlink(missing_ok=True)
                 log.warning(
-                    "reclaiming stale broker lock %s (holder pid %d is dead)",
+                    "reclaimed stale broker lock %s (holder pid %d is dead)",
                     lock, holder,
                 )
-                lock.unlink(missing_ok=True)
                 return ensure_broker(
                     cluster_name, root=root, advertise=advertise, port=port,
                     timeout_s=max(deadline - time.monotonic(), 5.0),
@@ -251,12 +264,19 @@ def teardown_broker(cluster_name: str, root: Path | None = None) -> dict:
 
     # Never SIGTERM a recycled pid: after a reboot the record survives but
     # the OS may have reassigned the pid to an unrelated same-user
-    # process.  Only kill when the pid's cmdline is actually the broker.
-    try:
-        cmdline = Path(f"/proc/{pid}/cmdline").read_bytes().decode(errors="replace")
-    except OSError:
-        cmdline = ""
-    if "dlcfn-broker" not in cmdline:
+    # process.  On Linux, verify the pid's cmdline is actually the broker;
+    # elsewhere (no /proc) fall back to the port answering PING — a live
+    # recorded port IS the broker we started.
+    proc_cmdline = Path(f"/proc/{pid}/cmdline")
+    if proc_cmdline.parent.exists():
+        try:
+            cmdline = proc_cmdline.read_bytes().decode(errors="replace")
+        except OSError:
+            cmdline = ""
+        is_broker = "dlcfn-broker" in cmdline
+    else:
+        is_broker = bool(status["alive"])
+    if not is_broker:
         rec.unlink(missing_ok=True)
         rec.with_suffix(".log").unlink(missing_ok=True)
         rec.with_suffix(".lock").unlink(missing_ok=True)

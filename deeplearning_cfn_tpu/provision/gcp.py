@@ -94,6 +94,9 @@ class GCPBackend(Backend):
     # Full worker boot script (cluster/startup.py); falls back to the bare
     # agent exec when not supplied.
     startup_script: str | None = None
+    # Distinguishes generated storage ids between clusters sharing a
+    # project/zone/mount_point (set to the cluster name by the CLI).
+    storage_namespace: str = ""
     # GCS bucket holding cross-process controller state: resource-signal
     # markers and group records.  The deployable analog of CloudFormation's
     # per-stack WaitCondition handle + stack-resource table
@@ -430,11 +433,14 @@ class GCPBackend(Backend):
         # Stable digest, NOT hash(): string hashing is randomized per
         # process (PYTHONHASHSEED), which would name a different resource
         # for the same spec on every run — create-or-reuse needs the same
-        # spec to map to the same id from any process.
+        # spec to map to the same id from any process.  The namespace
+        # (cluster name) keeps two clusters in one project/zone from
+        # colliding on a shared default mount point: --force-storage on
+        # one must never delete the other's checkpoints.
         import hashlib
 
         digest = hashlib.sha256(
-            f"{self.project}/{self.zone}/{mount_point}".encode()
+            f"{self.project}/{self.zone}/{self.storage_namespace}/{mount_point}".encode()
         ).hexdigest()[:6]
         sid = f"dlcfn-{kind}-{digest}"
         if kind == "filestore":

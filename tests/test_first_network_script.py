@@ -1,0 +1,78 @@
+"""In-env smoke of scripts/first-network-session.sh (VERDICT r2 #8).
+
+The real run needs a network (downloads); the smoke proves every stage
+AFTER download — convert -> train-to-target with held-out eval -> COCO
+mAP eval — by pointing DLCFN_FNS_SRC at fixture data in exactly the
+layout the downloads produce.  When a networked session exists, the
+same script without DLCFN_FNS_SRC is the 10-minute acceptance run.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tests.test_datasets import (
+    write_cifar10_fixture,
+    write_coco_fixture,
+    write_mnist_fixture,
+)
+
+REPO = Path(__file__).parent.parent
+SCRIPT = REPO / "scripts" / "first-network-session.sh"
+
+
+@pytest.mark.slow
+def test_script_runs_all_stages_on_fixture_data(tmp_path):
+    src = tmp_path / "src"
+    # The exact layouts stage 1 downloads into:
+    write_cifar10_fixture(src / "cifar", n_per_batch=64, n_batches=2)
+    write_mnist_fixture(src / "mnist", n=32)
+    coco_root = tmp_path / "coco-fixture"
+    img_dir, ann_path, images, _ = write_coco_fixture(coco_root, n_images=12)
+    (src / "coco" / "train").mkdir(parents=True)
+    (src / "coco" / "val").mkdir(parents=True)
+    for i, info in enumerate(images):
+        dest = "train" if i < 9 else "val"
+        shutil.copy(img_dir / info["file_name"], src / "coco" / dest / info["file_name"])
+    shutil.copy(ann_path, src / "coco" / "instances_val2017.json")
+
+    env = dict(
+        os.environ,
+        DLCFN_FNS_SRC=str(src),
+        DLCFN_FNS_WORK=str(tmp_path / "work"),
+        DLCFN_FNS_TARGET="0.05",  # reachable in a few steps on fixtures
+        DLCFN_FNS_STEPS="12",
+        DLCFN_FNS_DET_STEPS="2",
+        DLCFN_FNS_SIZE="64",
+        DLCFN_FNS_BATCH="16",
+        DLCFN_FNS_DET_BATCH="2",
+        DLCFN_FNS_DET_BACKBONE="tiny",
+        PYTHON=sys.executable,
+        JAX_PLATFORMS="cpu",
+    )
+    proc = subprocess.run(
+        ["bash", str(SCRIPT), str(tmp_path / "work")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    summary = json.loads((tmp_path / "work" / "summary.json").read_text())
+    # Conversions happened and counted records.
+    assert summary["convert_cifar"]["records"]["train"] == 128
+    assert summary["convert_mnist"]["records"]["train"] == 32
+    assert summary["convert_coco_train"]["records"]["train"] == 9
+    assert summary["convert_coco_val"]["records"]["val"] == 3
+    # CIFAR trained with a held-out eval attached.
+    assert summary["cifar"]["steps"] >= 1
+    assert "accuracy" in summary["cifar"]["eval"]
+    # COCO trained and produced an mAP eval.
+    assert summary["coco"]["steps"] == 2
+    assert "map50" in summary["coco"]["eval"] or "mAP" in str(summary["coco"]["eval"])
