@@ -168,11 +168,27 @@ def ensure_broker(
                 # Atomic reclaim: rename wins exactly once, so two waiters
                 # observing the same dead holder cannot both proceed (the
                 # loser's rename fails and it keeps waiting for the
-                # winner's record).
+                # winner's record).  The rename alone is not enough — a
+                # slow waiter could rename the WINNER's fresh lock — so
+                # verify the renamed file still names the dead holder and
+                # restore it if not.
                 stale = lock.with_suffix(".stale")
                 try:
                     os.rename(lock, stale)
-                except (FileNotFoundError, OSError):
+                except FileNotFoundError:
+                    time.sleep(0.1)
+                    continue
+                try:
+                    renamed_holder = int(stale.read_text().strip() or 0)
+                except (FileNotFoundError, ValueError):
+                    renamed_holder = 0
+                if renamed_holder != holder:
+                    # We grabbed a lock newer than the one we observed
+                    # dead: put it back and keep waiting on its owner.
+                    try:
+                        os.rename(stale, lock)
+                    except OSError:
+                        pass
                     time.sleep(0.1)
                     continue
                 stale.unlink(missing_ok=True)
@@ -264,24 +280,28 @@ def teardown_broker(cluster_name: str, root: Path | None = None) -> dict:
 
     # Never SIGTERM a recycled pid: after a reboot the record survives but
     # the OS may have reassigned the pid to an unrelated same-user
-    # process.  On Linux, verify the pid's cmdline is actually the broker;
-    # elsewhere (no /proc) fall back to the port answering PING — a live
-    # recorded port IS the broker we started.
-    proc_cmdline = Path(f"/proc/{pid}/cmdline")
-    if proc_cmdline.parent.exists():
+    # process.  On procfs systems (every deployment target: TPU VMs /
+    # GCE / the dev containers), verify the pid's cmdline is actually the
+    # broker.  Without /proc there is NO safe way to verify a pid's
+    # identity — a live port answering PING does not prove the recorded
+    # pid is the broker — so never signal: clean the records and report
+    # the pid for the operator.
+    if Path("/proc").exists():
         try:
-            cmdline = proc_cmdline.read_bytes().decode(errors="replace")
+            cmdline = (
+                Path(f"/proc/{pid}/cmdline").read_bytes().decode(errors="replace")
+            )
         except OSError:
-            cmdline = ""
-        is_broker = "dlcfn-broker" in cmdline
+            cmdline = ""  # pid gone entirely: nothing to kill
+        verdict = "stale-record" if "dlcfn-broker" not in cmdline else None
     else:
-        is_broker = bool(status["alive"])
-    if not is_broker:
+        verdict = "left-running"
+    if verdict is not None:
         rec.unlink(missing_ok=True)
         rec.with_suffix(".log").unlink(missing_ok=True)
         rec.with_suffix(".lock").unlink(missing_ok=True)
         return {
-            "broker": "stale-record",
+            "broker": verdict,
             "host": status["host"],
             "port": status["port"],
             "pid": pid,

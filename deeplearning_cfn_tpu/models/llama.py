@@ -54,6 +54,12 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # "full": recompute the whole block in backward (lowest memory).
+    # "dots": save matmul outputs, recompute only elementwise
+    # (jax.checkpoint_policies.dots_with_no_batch_dims_saveable) — the
+    # standard transformer policy; measured +3% step throughput on the
+    # 435M bench shape for a modest activation-memory increase.
+    remat_policy: str = "full"
     # Tie input/output embeddings (small configs); 8B does not tie.
     tied_embeddings: bool = False
     # Sequence-parallel ring attention (parallel/ring_attention.py) instead
@@ -111,18 +117,25 @@ class LlamaConfig:
     @classmethod
     def m435(cls, seq_len: int = 1024) -> "LlamaConfig":
         """The ~435M single-chip benchmark shape (docs/BENCH_NOTES.md:
-        21.3k tok/s at 30% analytic MFU on one v5e) — big enough to fill
-        the MXU, small enough for one 16 GB chip with adamw."""
+        30k tok/s at 42% analytic MFU on one v5e) — big enough to fill
+        the MXU, small enough for one 16 GB chip with adamw.
+
+        head_dim 128 (8 heads), the real-Llama convention: the round-3
+        trace showed head_dim 64 feeding the 128-wide MXU half-empty in
+        every attention matmul — same FLOPs, measured 0.32 -> 0.41 MFU
+        from this change alone."""
         return cls(
             vocab_size=32000,
             dim=1024,
             n_layers=24,
-            n_heads=16,
-            n_kv_heads=16,
+            n_heads=8,
+            n_kv_heads=8,
             mlp_dim=4096,
             max_seq_len=seq_len,
             tied_embeddings=True,
             use_flash_attention=True,
+            # Fits comfortably at the bench shape; +3% over full remat.
+            remat_policy="dots",
         )
 
     @classmethod
@@ -381,7 +394,12 @@ def forward_with_aux(
 
     block = partial(_block, cfg, mesh)
     if cfg.remat:
-        block = jax.checkpoint(block, static_argnums=())
+        policy = (
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            if cfg.remat_policy == "dots"
+            else None
+        )
+        block = jax.checkpoint(block, static_argnums=(), policy=policy)
 
     def scan_body(carry, lp):
         x, aux_sum = carry
@@ -421,13 +439,21 @@ def forward_with_aux(
         logits = x @ params["embed"].astype(cfg.dtype).T
     else:
         logits = x @ params["output"]
-    return logits.astype(jnp.float32), aux_sum
+    # Logits stay in the COMPUTE dtype: materializing the [B, S, V] f32
+    # copy here cost ~1 GB of HBM writes per pass at the 435M bench shape
+    # and dominated the out-of-scan step time (round-3 trace,
+    # docs/BENCH_NOTES.md).  Consumers that reduce over the vocab convert
+    # inside their reductions (exact: bf16 -> f32 is lossless), so loss
+    # numerics are identical to an f32 materialization.
+    return logits, aux_sum
 
 
 def forward(
     cfg: LlamaConfig, params: dict, tokens: jax.Array, mesh: Mesh | None = None
 ) -> jax.Array:
-    return forward_with_aux(cfg, params, tokens, mesh)[0]
+    """f32 logits — the inspection/eval entry point, not the train hot
+    path (the loss consumes compute-dtype logits directly)."""
+    return forward_with_aux(cfg, params, tokens, mesh)[0].astype(jnp.float32)
 
 
 class _FunctionalInit:
@@ -474,8 +500,15 @@ def causal_lm_loss(
     target wraps to the sequence start).  MoE configs add the router
     load-balancing aux loss to the objective (not to perplexity)."""
     logits, aux = forward_with_aux(cfg, params, tokens, mesh)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # Logsumexp form of -log_softmax[target]: nll = lse(logits) - gold.
+    # Identical math to log_softmax-then-gather, but the [B, S, V] tensor
+    # is only ever READ by reductions (XLA fuses the bf16->f32 convert
+    # into them) instead of materialized as an f32 copy plus a full-width
+    # f32 log_softmax — at V=32k that materialization was ~28% of the
+    # 435M training step (docs/BENCH_NOTES.md round-3 trace).
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = lse - gold.astype(jnp.float32)
     mask = jnp.ones_like(nll).at[:, -1].set(0.0)
     loss = jnp.sum(nll * mask) / jnp.sum(mask)
     metrics = {"perplexity": jnp.exp(loss)}

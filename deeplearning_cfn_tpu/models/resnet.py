@@ -70,7 +70,14 @@ class ResNet(nn.Module):
             use_running_average=not train,
             momentum=0.9,
             epsilon=1e-5,
-            dtype=jnp.float32,  # BN statistics always in f32
+            # Outputs in the compute dtype; statistics/params stay f32
+            # (flax computes mean/var in >= f32 and param_dtype defaults
+            # to f32, so running stats cannot diverge).  f32 BN outputs
+            # doubled HBM traffic on every normalization: the round-3
+            # trace attributed ~39% of the ResNet-50 step to BN-side
+            # elementwise+reduce fusions moving f32 activations
+            # (docs/BENCH_NOTES.md).
+            dtype=self.dtype,
         )
         x = x.astype(self.dtype)
         x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)

@@ -79,3 +79,34 @@ def test_storage_create_and_retain():
     assert handle.created
     assert not backend.delete_storage(handle.storage_id)  # retained
     assert backend.delete_storage(handle.storage_id, force=True)
+
+
+def test_storage_reuse_before_create_and_legacy_adoption():
+    """Spec-derived storage ids are probed before creation (recreate after
+    delete-with-retain reuses the bucket), and ids derived before the
+    namespace change (no cluster name in the digest) are adopted instead
+    of orphaning their checkpoints."""
+    import hashlib
+
+    transport = FakeGCPTransport()
+    spec = gcp_spec()
+    backend = make_backend(spec, transport)
+    backend.storage_namespace = "nsdemo"
+
+    h1 = backend.create_or_reuse_storage("gcs", None, "/mnt/dlcfn", True)
+    assert h1.created is True
+    # Same spec again: reused, not re-created.
+    h2 = backend.create_or_reuse_storage("gcs", None, "/mnt/dlcfn", True)
+    assert h2.created is False and h2.storage_id == h1.storage_id
+
+    # Legacy (pre-namespace) bucket exists; namespaced id does not ->
+    # adopt the legacy one.
+    # Legacy format: project/zone/mount joined with "/" (mount keeps its
+    # leading slash, hence the double slash).
+    legacy_digest = hashlib.sha256(
+        f"{backend.project}/{backend.zone}//mnt/other".encode()
+    ).hexdigest()[:6]
+    legacy_id = f"dlcfn-gcs-{legacy_digest}"
+    transport.buckets.add(legacy_id)
+    h3 = backend.create_or_reuse_storage("gcs", None, "/mnt/other", True)
+    assert h3.created is False and h3.storage_id == legacy_id
