@@ -50,16 +50,56 @@ def main() -> dict:
     n_local = len(jax.local_devices())
     n_proc = jax.process_count()
 
-    mesh = build_mesh(MeshSpec.data_parallel(n_global))
-    trainer = Trainer(
-        LeNet(num_classes=10),
-        mesh,
-        TrainerConfig(learning_rate=0.02, matmul_precision="float32"),
-    )
     steps = int(os.environ.get("DLCFN_SMOKE_STEPS", "10"))
-    batch = 8 * n_global
-    local = batch // n_proc
-    ds = SyntheticDataset(shape=(28, 28, 1), num_classes=10, batch_size=batch)
+    model_kind = os.environ.get("DLCFN_SMOKE_MODEL", "lenet")
+    if model_kind == "llama-fsdp":
+        # The flagship layout ACROSS process boundaries: params and
+        # optimizer state sharded over an fsdp axis that spans both
+        # processes (x tp within), so the per-step all-gathers /
+        # reduce-scatters — not just the gradient psum — cross the
+        # coordinator-established transport.  The BASELINE 8B config's
+        # communication pattern, proven on OS processes.
+        from deeplearning_cfn_tpu.models import llama
+
+        if n_local < 2 or n_global % 2:
+            raise SystemExit(
+                "DLCFN_SMOKE_MODEL=llama-fsdp needs >= 2 devices per "
+                "process (set XLA_FLAGS=--xla_force_host_platform_"
+                "device_count) and an even global device count, or the "
+                "fsdp axis cannot span the process boundary — the very "
+                "property this mode exists to prove"
+            )
+        mesh = build_mesh(MeshSpec(fsdp=n_global // 2, tp=2))
+        cfg = llama.LlamaConfig.tiny(vocab_size=64, seq_len=16)
+        trainer = llama.make_trainer(
+            cfg,
+            mesh,
+            TrainerConfig(strategy="fsdp", optimizer="adamw", learning_rate=1e-2),
+        )
+        batch = 2 * (n_global // 2)
+        local = batch // n_proc
+        rng = np.random.default_rng(7)
+        # One fixed batch, repeated: the smoke must show the loss
+        # DECREASING within a handful of steps (memorization), which
+        # fresh random tokens per step cannot.
+        from deeplearning_cfn_tpu.train.data import Batch
+
+        tokens = rng.integers(1, cfg.vocab_size, size=(batch, 16)).astype(np.int32)
+        one = Batch(x=tokens, y=np.roll(tokens, -1, 1))
+        batches = [one] * steps
+        init_x = jnp.asarray(tokens[:1])
+    else:
+        mesh = build_mesh(MeshSpec.data_parallel(n_global))
+        trainer = Trainer(
+            LeNet(num_classes=10),
+            mesh,
+            TrainerConfig(learning_rate=0.02, matmul_precision="float32"),
+        )
+        batch = 8 * n_global
+        local = batch // n_proc
+        ds = SyntheticDataset(shape=(28, 28, 1), num_classes=10, batch_size=batch)
+        batches = list(ds.batches(steps))
+        init_x = jnp.asarray(batches[0].x[:1])
 
     def to_global(arr: np.ndarray) -> jax.Array:
         # Every process holds the same global batch (deterministic
@@ -68,8 +108,7 @@ def main() -> dict:
             trainer.batch_sharding, arr[pid * local : (pid + 1) * local]
         )
 
-    batches = list(ds.batches(steps))
-    state = trainer.init(jax.random.key(0), jnp.asarray(batches[0].x[:1]))
+    state = trainer.init(jax.random.key(0), init_x)
     losses = []
     for b in batches:
         state, metrics = trainer.train_step(state, to_global(b.x), to_global(b.y))
@@ -79,6 +118,7 @@ def main() -> dict:
         "processes": n_proc,
         "local_devices": n_local,
         "global_devices": n_global,
+        "model": model_kind,
         "losses": losses,
     }
     print(json.dumps(result))

@@ -23,8 +23,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_training_agrees_and_learns(tmp_path):
+def _run_two_processes(model: str, steps: int = 8) -> list[dict]:
     port = _free_port()
     procs = []
     for pid in range(2):
@@ -36,7 +35,8 @@ def test_two_process_training_agrees_and_learns(tmp_path):
             DEEPLEARNING_WORKERS_COUNT="2",
             DLCFN_PROCESS_ID=str(pid),
             DEEPLEARNING_COORDINATOR=f"127.0.0.1:{port}",
-            DLCFN_SMOKE_STEPS="8",
+            DLCFN_SMOKE_STEPS=str(steps),
+            DLCFN_SMOKE_MODEL=model,
         )
         procs.append(
             subprocess.Popen(
@@ -52,7 +52,6 @@ def test_two_process_training_agrees_and_learns(tmp_path):
         out, err = p.communicate(timeout=600)
         assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
         outs.append(json.loads(out.strip().splitlines()[-1]))
-
     for pid, res in enumerate(outs):
         assert res["process_id"] == pid
         assert res["processes"] == 2
@@ -63,3 +62,19 @@ def test_two_process_training_agrees_and_learns(tmp_path):
     losses = outs[0]["losses"]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_training_agrees_and_learns(tmp_path):
+    _run_two_processes("lenet")
+
+
+@pytest.mark.slow
+def test_two_process_fsdp_tp_llama_shards_params_across_processes(tmp_path):
+    """The flagship fsdp x tp layout with the fsdp axis SPANNING the two
+    processes: per-step parameter all-gathers and gradient
+    reduce-scatters cross the process boundary (the 8B communication
+    pattern), not just a data-parallel psum."""
+    outs = _run_two_processes("llama-fsdp")
+    assert outs[0]["model"] == "llama-fsdp"
