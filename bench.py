@@ -32,9 +32,12 @@ MEASURE_STEPS = 20
 
 
 def main() -> None:
+    from deeplearning_cfn_tpu.examples.common import enable_compile_cache
     from deeplearning_cfn_tpu.models.resnet import ResNet50
     from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
     from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
+
+    enable_compile_cache()
 
     devices = jax.devices()
     n_chips = len(devices)

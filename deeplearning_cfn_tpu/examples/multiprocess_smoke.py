@@ -61,13 +61,13 @@ def main() -> dict:
         # communication pattern, proven on OS processes.
         from deeplearning_cfn_tpu.models import llama
 
-        if n_local < 2 or n_global % 2:
+        if n_local < 2 or n_local % 2 or n_global % 2:
             raise SystemExit(
-                "DLCFN_SMOKE_MODEL=llama-fsdp needs >= 2 devices per "
-                "process (set XLA_FLAGS=--xla_force_host_platform_"
-                "device_count) and an even global device count, or the "
-                "fsdp axis cannot span the process boundary — the very "
-                "property this mode exists to prove"
+                "DLCFN_SMOKE_MODEL=llama-fsdp needs an EVEN number of "
+                "devices per process, >= 2 (set XLA_FLAGS=--xla_force_"
+                "host_platform_device_count): each tp pair must sit "
+                "within one process and the fsdp axis must span the "
+                "process boundary — the property this mode exists to prove"
             )
         mesh = build_mesh(MeshSpec(fsdp=n_global // 2, tp=2))
         cfg = llama.LlamaConfig.tiny(vocab_size=64, seq_len=16)

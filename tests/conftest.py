@@ -9,6 +9,11 @@ as it would over an 8-chip slice.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Test isolation: examples enable a persistent XLA compile cache by
+# default (examples/common.enable_compile_cache); tests — including the
+# ones spawning example subprocesses — must not write the developer's
+# real ~/.cache.  setdefault so an operator can opt a run back in.
+os.environ.setdefault("DLCFN_COMPILE_CACHE", "off")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

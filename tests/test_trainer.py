@@ -303,3 +303,25 @@ def test_cost_analysis_source_for_dense_models():
     stats = tr.compile_stats(state, jnp.asarray(b.x), jnp.asarray(b.y))
     assert stats["flops_source"] == "cost_analysis"
     assert stats["flops_per_step"] == stats["cost_flops_per_step"]
+
+
+def test_enable_compile_cache_config_and_off_switch(tmp_path, monkeypatch):
+    """The persistent-cache helper must honor the off switch and set the
+    jax config when enabled (template-to-first-step depends on it)."""
+    from deeplearning_cfn_tpu.examples.common import enable_compile_cache
+
+    prior_dir = jax.config.jax_compilation_cache_dir
+    prior_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        monkeypatch.setenv("DLCFN_COMPILE_CACHE", "off")
+        assert enable_compile_cache() is None
+
+        monkeypatch.setenv("DLCFN_COMPILE_CACHE", str(tmp_path / "cc"))
+        got = enable_compile_cache()
+        assert got == str(tmp_path / "cc")
+        assert jax.config.jax_compilation_cache_dir == got
+    finally:
+        # jax.config survives monkeypatch: restore so later tests in this
+        # process don't write a cache rooted in this test's tmp_path.
+        jax.config.update("jax_compilation_cache_dir", prior_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", prior_min)
