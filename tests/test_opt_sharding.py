@@ -83,6 +83,55 @@ def test_llama_wq_wo_moments_not_collided(llama_state):
     assert layers["wo"].sharding.spec == P(None, "tp", "fsdp")
 
 
+@pytest.mark.slow
+def test_transposed_moments_would_add_resharding_collectives():
+    """The HLO-level form of the round-2 finding: reproduce the bug by
+    transposing wq/wv moment shardings and show the compiled step gains
+    resharding collectives that the path-aligned mapping does not have —
+    i.e. the fixed HLO carries no optimizer-state resharding."""
+    import re
+
+    from jax.sharding import NamedSharding
+
+    mesh = build_mesh(MeshSpec(fsdp=2, tp=2), jax.devices()[:4])
+    cfg = llama.LlamaConfig.tiny(vocab_size=64, seq_len=16)
+
+    def collective_count(trainer):
+        tok = np.zeros((4, 16), dtype=np.int32)
+        x = jax.device_put(jnp.asarray(tok), trainer.batch_sharding)
+        state = trainer.init(jax.random.key(0), x)
+        with jax.set_mesh(mesh):
+            hlo = trainer.step_fn.lower(state, x, x).compile().as_text()
+        return sum(
+            len(re.findall(k, hlo))
+            for k in ("all-to-all", "collective-permute", "all-gather", "all-reduce")
+        )
+
+    cfg_tc = TrainerConfig(strategy="fsdp", optimizer="adamw")
+    fixed = llama.make_trainer(cfg, mesh, cfg_tc)
+    n_fixed = collective_count(fixed)
+
+    broken = llama.make_trainer(cfg, mesh, cfg_tc)
+    orig = broken._opt_state_shardings
+    swap = NamedSharding(mesh, P(None, "tp", "fsdp"))
+
+    def transpose_wq_wv(abstract_params, param_sh):
+        sh = orig(abstract_params, param_sh)
+        return jax.tree_util.tree_map_with_path(
+            lambda path, s: (
+                swap
+                if any("wq" in str(k) or "wv" in str(k) for k in path)
+                and s.spec == P(None, "fsdp", "tp")
+                else s
+            ),
+            sh,
+        )
+
+    broken._opt_state_shardings = transpose_wq_wv
+    n_broken = collective_count(broken)
+    assert n_broken > n_fixed, (n_fixed, n_broken)
+
+
 @pytest.mark.parametrize("optimizer", ["momentum", "lamb"])
 def test_other_optimizers_path_aligned(optimizer):
     """The fix must hold for every supported optimizer, including ones
