@@ -306,12 +306,19 @@ def cmd_delete(args) -> int:
         prov = dry.seed(backend, spec)
     else:
         prov = Provisioner(backend, spec)
-    out = prov.delete(force_storage=args.force_storage)
     if dry is not None:
+        prov.delete(force_storage=args.force_storage)
         return dry.emit("delete")
     # The broker is a stack resource: delete tears it down with the
-    # cluster (a no-op when none was auto-provisioned).
-    out.update(teardown_broker(spec.name))
+    # cluster (a no-op when none was auto-provisioned).  finally: broker
+    # teardown is independent of cloud-resource deletion — a transport
+    # error mid-teardown must not leave the detached broker running with
+    # no cleanup path besides re-running delete.
+    try:
+        out = prov.delete(force_storage=args.force_storage)
+    finally:
+        broker_out = teardown_broker(spec.name)
+    out.update(broker_out)
     print(json.dumps(out, indent=2))
     return 0
 

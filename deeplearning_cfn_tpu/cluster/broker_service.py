@@ -21,6 +21,12 @@ stamped into TPU VM metadata exactly as an explicit ``--broker HOST:PORT``
 would be (provision/gcp.py broker_host).  ``advertise`` selects the address
 written to the record: loopback for the local/dev backend, this host's
 routable IP (or an explicit override) for real clusters.
+
+Exposure: the broker is bound to loopback plus the advertise interface
+only (and this host's outbound interface when the advertise address is a
+non-local NAT/public IP, since that is where forwarded traffic actually
+arrives) — never all interfaces.  An unauthenticated rendezvous plane
+must not listen on interfaces no cluster VM dials.
 """
 
 from __future__ import annotations
@@ -76,13 +82,29 @@ def _alive(host: str, port: int, timeout_s: float = 2.0) -> bool:
         return False
 
 
+def _bind_addresses(advertise: str | None) -> str:
+    """The comma-separated bind list handed to the broker binary: loopback
+    (liveness probes + the local/dev backend) plus the advertise interface.
+    A non-local advertise address (operator NAT/public IP) cannot be bound
+    — the binary skips it — so the host's outbound interface is included
+    too, which is where NAT-forwarded traffic actually arrives."""
+    addrs = ["127.0.0.1"]
+    if advertise and advertise not in addrs:
+        addrs.append(advertise)
+        host_ip = detect_host_ip()
+        if host_ip not in addrs:
+            addrs.append(host_ip)
+    return ",".join(addrs)
+
+
 def broker_status(cluster_name: str, root: Path | None = None) -> dict | None:
     """The recorded broker for a cluster, plus liveness — or None.
 
     Liveness is probed on LOOPBACK: the broker always runs on this host
-    (it binds all interfaces); the recorded ``host`` is only the address
-    VMs dial, which may be a NAT/public IP not locally routable — probing
-    it would misread a live broker as dead and spawn a leaked duplicate."""
+    (loopback is always in its bind list); the recorded ``host`` is only
+    the address VMs dial, which may be a NAT/public IP not locally
+    routable — probing it would misread a live broker as dead and spawn a
+    leaked duplicate."""
     rec = _record_path(cluster_name, root)
     try:
         data = json.loads(rec.read_text())
@@ -102,28 +124,61 @@ def ensure_broker(
     """Return ``(host, port, started)`` for a live broker serving this
     cluster, starting one (detached) if none is recorded and reachable."""
     rec = _record_path(cluster_name, root)
+
+    def reuse_live(record: dict) -> tuple[str, int, bool] | None:
+        """Return a live recorded broker, rewriting the advertised host
+        when the caller passes a different one — the record's host is only
+        the address VMs dial; an operator re-running with a (corrected)
+        advertise address must not be silently held to the old one.  Used
+        by BOTH reuse paths (uncontended and lock-contention wait), so a
+        ``create --broker-advertise X`` racing a concurrent ``run`` cannot
+        come back with the other process's advertise address.
+
+        Returns None when the rewrite needs interfaces the running broker
+        never bound (its bind set is fixed at spawn): handing VMs an
+        address nothing listens on would hang bootstrap with connection
+        refusals.  The caller restarts the broker with the right binds."""
+        host = record["host"]
+        if advertise is not None and advertise != host:
+            # Records from before binds were narrowed carry no bind list;
+            # those brokers bound all interfaces, so any rewrite is safe.
+            bound = set(str(record.get("binds", "*")).split(","))
+            needed = set(_bind_addresses(advertise).split(","))
+            if "*" not in bound and not needed <= bound:
+                log.warning(
+                    "advertise %s needs interfaces the live broker never "
+                    "bound (%s); restarting it with the wider bind set",
+                    advertise, ",".join(sorted(bound)),
+                )
+                return None
+            log.warning(
+                "rewriting broker advertise address for %s: %s -> %s",
+                cluster_name, host, advertise,
+            )
+            record["host"] = host = advertise
+            rec.write_text(
+                json.dumps({k: v for k, v in record.items() if k != "alive"})
+            )
+        log.info(
+            "reusing broker for %s at %s:%s (pid %s)",
+            cluster_name, host, record["port"], record["pid"],
+        )
+        return host, int(record["port"]), False
+
+    def restart_with_wider_binds() -> tuple[str, int, bool]:
+        teardown_broker(cluster_name, root)
+        return ensure_broker(
+            cluster_name, root=root, advertise=advertise, port=port,
+            timeout_s=timeout_s,
+        )
+
     existing = broker_status(cluster_name, root)
     if existing is not None:
         if existing["alive"]:
-            host = existing["host"]
-            if advertise is not None and advertise != host:
-                # The broker binds all interfaces; the record's host is
-                # only the address VMs dial.  An operator re-running with
-                # a (corrected) advertise address must not be silently
-                # held to the old one.
-                log.warning(
-                    "rewriting broker advertise address for %s: %s -> %s",
-                    cluster_name, host, advertise,
-                )
-                existing["host"] = host = advertise
-                rec.write_text(
-                    json.dumps({k: v for k, v in existing.items() if k != "alive"})
-                )
-            log.info(
-                "reusing broker for %s at %s:%s (pid %s)",
-                cluster_name, host, existing["port"], existing["pid"],
-            )
-            return host, int(existing["port"]), False
+            reused = reuse_live(existing)
+            if reused is None:
+                return restart_with_wider_binds()
+            return reused
         log.warning(
             "recorded broker for %s at %s:%s is dead; starting a new one",
             cluster_name, existing["host"], existing["port"],
@@ -146,7 +201,12 @@ def ensure_broker(
         while time.monotonic() < deadline:
             st = broker_status(cluster_name, root)
             if st is not None and st["alive"]:
-                return st["host"], int(st["port"]), False
+                reused = reuse_live(st)
+                if reused is None:
+                    # The race winner's broker lacks interfaces this
+                    # caller's advertise needs; replace it.
+                    return restart_with_wider_binds()
+                return reused
             # Stale-lock reclaim: the holder wrote its pid for exactly
             # this check — a crash between lock and unlink must not brick
             # --broker auto until manual cleanup.
@@ -213,8 +273,10 @@ def ensure_broker(
         try:
             # start_new_session: the broker is a stack resource that must
             # survive this CLI process (and its process group / terminal).
+            # The explicit bind list keeps the unauthenticated rendezvous
+            # plane off interfaces no cluster VM dials (see module doc).
             proc = subprocess.Popen(
-                [str(BROKER_BIN), str(port)],
+                [str(BROKER_BIN), str(port), _bind_addresses(advertise)],
                 stdout=log_fh,
                 stderr=subprocess.STDOUT,
                 start_new_session=True,
@@ -256,6 +318,10 @@ def ensure_broker(
                     "host": host,
                     "port": bound_port,
                     "pid": proc.pid,
+                    # What the broker actually listens on — consulted on
+                    # reuse so an advertise rewrite never hands VMs an
+                    # address nothing is bound to.
+                    "binds": _bind_addresses(advertise),
                     "started_ts": time.time(),
                 }
             )

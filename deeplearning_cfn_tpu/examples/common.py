@@ -101,6 +101,38 @@ def base_parser(description: str) -> argparse.ArgumentParser:
              "(train batches only)",
     )
     p.add_argument(
+        "--augment_crop",
+        action="store_true",
+        help="random-crop augmentation for uint8 image records: margin-"
+             "converted records get a random window, same-size records "
+             "get the classic pad-and-crop (see --crop_pad)",
+    )
+    p.add_argument(
+        "--crop_pad", type=int, default=4,
+        help="zero-padding per side for --augment_crop on records already "
+             "at the model's input size (the CIFAR pad-4 recipe)",
+    )
+    p.add_argument(
+        "--lr_schedule", choices=["constant", "cosine", "step"],
+        default="constant",
+        help="LR schedule over --steps: warmup+cosine decay, or the "
+             "reference-style stepped decay (run.sh:93 LR_SCHEDULE)",
+    )
+    p.add_argument(
+        "--warmup_steps", type=int, default=None,
+        help="linear LR warmup steps (default: 5%% of --steps capped at "
+             "1000 for cosine, 0 for step)",
+    )
+    p.add_argument(
+        "--lr_boundaries", default=None,
+        help="comma-separated step indices for --lr_schedule step "
+             "(default: 50%%,75%%,90%% of --steps)",
+    )
+    p.add_argument(
+        "--lr_decay_factor", type=float, default=0.1,
+        help="multiplier applied at each step-schedule boundary",
+    )
+    p.add_argument(
         "--metrics_dir",
         default=os.environ.get("DLCFN_METRICS_DIR"),
         help="dir for structured per-worker JSONL metrics (typically the "

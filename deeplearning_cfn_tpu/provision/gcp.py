@@ -439,34 +439,28 @@ class GCPBackend(Backend):
         # one must never delete the other's checkpoints.
         import hashlib
 
-        def derived_id(namespace: str) -> str:
-            # Empty segments are dropped so namespace="" reproduces the
-            # pre-namespace digest format exactly (the legacy ids).
-            key = "/".join(
-                p for p in (self.project, self.zone, namespace, mount_point) if p
-            )
-            digest = hashlib.sha256(key.encode()).hexdigest()[:6]
-            return f"dlcfn-{kind}-{digest}"
-
-        sid = derived_id(self.storage_namespace)
+        key = "/".join(
+            p
+            for p in (self.project, self.zone, self.storage_namespace, mount_point)
+            if p
+        )
+        sid = f"dlcfn-{kind}-{hashlib.sha256(key.encode()).hexdigest()[:6]}"
         # Reuse-before-create: the spec-derived resource may already exist
-        # (recreate after delete-with-retain).  Deployments from before
-        # ids were namespaced derived them without the cluster name —
-        # probe that legacy id too rather than orphaning its checkpoints.
-        for candidate in dict.fromkeys([sid, derived_id("")]):
-            if self.storage_exists(candidate, kind):
-                if candidate != sid:
-                    log.info(
-                        "adopting legacy storage id %s (pre-namespace digest)",
-                        candidate,
-                    )
-                return StorageHandle(
-                    storage_id=candidate,
-                    kind=kind,
-                    mount_point=mount_point,
-                    created=False,
-                    retain_on_delete=retain,
-                )
+        # (recreate after delete-with-retain).  No legacy-id probe: ids
+        # from before this digest were derived with Python's randomized
+        # builtin hash() and are irreproducible — no re-derived candidate
+        # can ever match one, and a shared un-namespaced fallback id would
+        # reintroduce the cross-cluster --force-storage hazard the
+        # namespace exists to prevent.  Pre-digest resources are adopted
+        # explicitly via the spec's existing_id instead.
+        if self.storage_exists(sid, kind):
+            return StorageHandle(
+                storage_id=sid,
+                kind=kind,
+                mount_point=mount_point,
+                created=False,
+                retain_on_delete=retain,
+            )
         if kind == "filestore":
             self.transport(
                 "POST",

@@ -113,6 +113,86 @@ def flipped_batches(
         yield Batch(x=x, y=b.y)
 
 
+def random_crop_batches(
+    batches: Iterator[Batch],
+    out_hw: tuple[int, int],
+    pad: int = 0,
+    seed: int = 0,
+) -> Iterator[Batch]:
+    """Random-crop augmentation ([B, H, W, C] layout) — the second half of
+    the standard vision recipe (flip alone cannot carry ResNet-50 to 76%
+    or VGG reliably to the reference's 92%, README.md:141).
+
+    Two source shapes, one behavior — every output is ``out_hw``:
+
+    - records LARGER than ``out_hw`` (converted with a pixel margin,
+      ``convert_imagefolder(margin=...)``): a random window per image —
+      the fixed-shape-records analog of torchvision's RandomCrop.
+    - records EQUAL to ``out_hw`` with ``pad`` > 0: zero-pad then crop,
+      the classic CIFAR pad-4 recipe.
+
+    Output arrays are freshly allocated, so downstream in-place transforms
+    (flip) are safe without another copy.
+    """
+    rng = np.random.default_rng(seed)
+    th, tw = out_hw
+    for b in batches:
+        x = b.x
+        n, h, w, c = x.shape
+        if (h, w) == (th, tw) and pad:
+            padded = np.zeros((n, h + 2 * pad, w + 2 * pad, c), x.dtype)
+            padded[:, pad : pad + h, pad : pad + w] = x
+            x, h, w = padded, h + 2 * pad, w + 2 * pad
+        if (h, w) == (th, tw):
+            yield b
+            continue
+        if h < th or w < tw:
+            raise ValueError(f"cannot crop {h}x{w} records to {th}x{tw}")
+        ys = rng.integers(0, h - th + 1, n)
+        xs = rng.integers(0, w - tw + 1, n)
+        out = np.empty((n, th, tw, c), x.dtype)
+        for i in range(n):
+            out[i] = x[i, ys[i] : ys[i] + th, xs[i] : xs[i] + tw]
+        yield Batch(x=out, y=b.y)
+
+
+def center_crop_batches(
+    batches: Iterator[Batch], out_hw: tuple[int, int]
+) -> Iterator[Batch]:
+    """Deterministic center crop to ``out_hw`` — the eval-side counterpart
+    of :func:`random_crop_batches` for margin-converted records (train and
+    eval must agree on the model's input size, not on augmentation)."""
+    th, tw = out_hw
+    for b in batches:
+        x = b.x
+        _, h, w, _ = x.shape
+        if (h, w) == (th, tw):
+            yield b
+            continue
+        if h < th or w < tw:
+            raise ValueError(f"cannot crop {h}x{w} records to {th}x{tw}")
+        top, left = (h - th) // 2, (w - tw) // 2
+        yield Batch(x=x[:, top : top + th, left : left + tw].copy(), y=b.y)
+
+
+def inferred_margin_spec(
+    record_size: int, image_shape: Sequence[int]
+) -> RecordSpec | None:
+    """The RecordSpec of a margin-converted record file: a LARGER square
+    uint8 image with the same channel count as ``image_shape`` (plus the
+    int32 label).  None when ``record_size`` doesn't decode to one."""
+    import math
+
+    c = int(image_shape[-1])
+    payload = record_size - 4  # int32 label
+    if payload <= 0 or payload % c:
+        return None
+    side = math.isqrt(payload // c)
+    if side * side * c != payload or side < max(image_shape[0], image_shape[1]):
+        return None
+    return RecordSpec.classification((side, side, c), "uint8")
+
+
 def normalized_batches(
     batches: Iterator[Batch],
     mean: np.ndarray,
@@ -271,12 +351,20 @@ def convert_imagefolder(
     size: int = 224,
     split: str = "train",
     class_names: Sequence[str] | None = None,
+    margin: int = 0,
 ) -> dict:
     """``<src>/<class>/*.{jpg,jpeg,png}`` -> ``<split>.dlc``.
 
     ``class_names`` pins the class->index mapping (pass the training
     split's mapping when converting val so labels agree); default is the
     sorted subdirectory names, torchvision's convention.
+
+    ``margin``: extra pixels stored per side beyond ``size`` — records
+    become ``(size+margin)``-square so training can random-crop a fresh
+    ``size``-window every epoch (:func:`random_crop_batches`) while
+    records stay fixed-shape (the TPU-first constraint).  Eval splits
+    should convert with ``margin=0`` (the standard center-crop eval
+    transform is baked at ingest).
     """
     src, out_dir = Path(src), Path(out_dir)
     classes = list(class_names) if class_names else sorted(
@@ -285,7 +373,8 @@ def convert_imagefolder(
     if not classes:
         raise DatasetFormatError(f"no class subdirectories under {src}")
     index = {c: i for i, c in enumerate(classes)}
-    spec = imagefolder_spec(size)
+    stored = size + max(0, margin)
+    spec = imagefolder_spec(stored)
 
     def gen():
         for cls in classes:
@@ -293,19 +382,20 @@ def convert_imagefolder(
                 if img.suffix.lower() not in (".jpg", ".jpeg", ".png", ".bmp"):
                     continue
                 yield spec.encode(
-                    x=_load_image_rgb(img, size), y=np.int32(index[cls])
+                    x=_load_image_rgb(img, stored), y=np.int32(index[cls])
                 )
 
     n = write_records(out_dir / f"{split}.dlc", spec, gen())
     (out_dir / "classes.json").write_text(json.dumps(classes))
     write_stats_sidecar(out_dir, "imagenet", IMAGENET_MEAN, IMAGENET_STD)
-    log.info("imagefolder %s: %d records (%d classes) -> %s",
-             split, n, len(classes), out_dir)
+    log.info("imagefolder %s: %d records (%d classes, stored %dpx) -> %s",
+             split, n, len(classes), stored, out_dir)
     return {
-        "spec": f"imagefolder{size}",
+        "spec": f"imagefolder{stored}",
         "out_dir": str(out_dir),
         "records": {split: n},
         "classes": len(classes),
+        "stored_px": stored,
     }
 
 

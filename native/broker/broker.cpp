@@ -275,36 +275,87 @@ void serve(int fd) {
 
 }  // namespace
 
-int main(int argc, char** argv) {
-  int port = argc > 1 ? std::atoi(argv[1]) : 8477;
+// Bind one listener on addr_text:port.  Returns the fd or -1 (callers may
+// treat a failed bind on a secondary address as non-fatal).
+int make_listener(const std::string& addr_text, int port, int* bound_port) {
   int listener = socket(AF_INET, SOCK_STREAM, 0);
-  if (listener < 0) {
-    std::perror("socket");
-    return 1;
-  }
+  if (listener < 0) return -1;
   int one = 1;
   setsockopt(listener, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (addr_text.empty() || addr_text == "*") {
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  } else if (inet_pton(AF_INET, addr_text.c_str(), &addr.sin_addr) != 1) {
+    std::fprintf(stderr, "dlcfn-broker: bad address '%s'\n", addr_text.c_str());
+    close(listener);
+    return -1;
+  }
   addr.sin_port = htons(static_cast<uint16_t>(port));
-  if (bind(listener, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0) {
-    std::perror("bind");
-    return 1;
+  if (bind(listener, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0 ||
+      listen(listener, 64) != 0) {
+    // errno matters operationally: EADDRINUSE (a leaked broker on the
+    // port) reads very differently from a non-local address.
+    std::perror(("dlcfn-broker bind/listen " + addr_text).c_str());
+    close(listener);
+    return -1;
   }
-  if (listen(listener, 64) != 0) {
-    std::perror("listen");
-    return 1;
-  }
-  // Report the actual port (port 0 = ephemeral, used by tests).
   socklen_t alen = sizeof addr;
   getsockname(listener, reinterpret_cast<sockaddr*>(&addr), &alen);
-  std::printf("dlcfn-broker listening on %d\n", ntohs(addr.sin_port));
-  std::fflush(stdout);
+  *bound_port = ntohs(addr.sin_port);
+  return listener;
+}
+
+void accept_loop(int listener) {
+  int one = 1;
   while (true) {
     int fd = accept(listener, nullptr, nullptr);
     if (fd < 0) continue;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
     std::thread(serve, fd).detach();
   }
+}
+
+// argv: [port] [bind_addrs]
+//   bind_addrs: comma-separated IPv4 addresses to listen on ("*" = all
+//   interfaces).  Default is all interfaces (back-compat for direct
+//   spawns); the broker_service supervisor always passes an explicit list
+//   (loopback + the advertise interface) so an auto-provisioned control
+//   plane is never exposed on every interface of the operator host.
+int main(int argc, char** argv) {
+  int port = argc > 1 ? std::atoi(argv[1]) : 8477;
+  std::string addrs_arg = argc > 2 ? argv[2] : "*";
+  std::vector<std::string> addrs;
+  {
+    std::stringstream ss(addrs_arg);
+    std::string item;
+    while (std::getline(ss, item, ','))
+      if (!item.empty()) addrs.push_back(item);
+  }
+  if (addrs.empty()) addrs.push_back("*");
+  std::vector<int> listeners;
+  int bound_port = port;
+  for (const auto& a : addrs) {
+    // All listeners share one port: the first bind may pick an ephemeral
+    // port (port 0, used by tests); later binds reuse the concrete one.
+    int p = listeners.empty() ? port : bound_port;
+    int fd = make_listener(a, p, &bound_port);
+    if (fd < 0) {
+      // Non-local addresses (an operator's NAT/public advertise IP) are
+      // expected to fail; the supervisor includes the real interface too.
+      std::printf("dlcfn-broker skipping unbindable address %s\n", a.c_str());
+      continue;
+    }
+    listeners.push_back(fd);
+  }
+  if (listeners.empty()) {
+    std::fprintf(stderr, "dlcfn-broker: no bindable address in '%s'\n",
+                 addrs_arg.c_str());
+    return 1;
+  }
+  std::printf("dlcfn-broker listening on %d\n", bound_port);
+  std::fflush(stdout);
+  for (size_t i = 1; i < listeners.size(); i++)
+    std::thread(accept_loop, listeners[i]).detach();
+  accept_loop(listeners[0]);
 }

@@ -7,6 +7,7 @@ reproduce that lifecycle for the native broker.
 
 import json
 import os
+import re
 import shutil
 
 import pytest
@@ -81,16 +82,33 @@ def test_restart_after_crash_ignores_stale_log(tmp_path):
 
 
 def test_reuse_rewrites_advertise_address(tmp_path):
-    """Re-running with a different --broker-advertise must take effect on
-    a live reused broker (the recorded host is only what VMs dial)."""
+    """Re-running with a different --broker-advertise must take effect —
+    and because the original broker bound loopback only, the service must
+    RESTART it with the wider bind set rather than hand VMs an address
+    nothing listens on."""
     _, port, _ = ensure_broker("svc", root=tmp_path, advertise="127.0.0.1")
     try:
         host2, port2, started2 = ensure_broker(
             "svc", root=tmp_path, advertise="10.9.9.9"
         )
-        assert (host2, port2, started2) == ("10.9.9.9", port, False)
+        assert (host2, started2) == ("10.9.9.9", True)
         rec = json.loads((tmp_path / "broker" / "svc.json").read_text())
         assert rec["host"] == "10.9.9.9"
+        assert "10.9.9.9" in rec["binds"].split(",")
+        assert broker_status("svc", root=tmp_path)["alive"] is True
+    finally:
+        teardown_broker("svc", root=tmp_path)
+
+
+def test_reuse_without_advertise_change_keeps_broker(tmp_path):
+    """A same-advertise reuse (the common run-after-create path) must not
+    restart anything."""
+    _, port, _ = ensure_broker("svc", root=tmp_path, advertise="127.0.0.1")
+    try:
+        host2, port2, started2 = ensure_broker(
+            "svc", root=tmp_path, advertise="127.0.0.1"
+        )
+        assert (host2, port2, started2) == ("127.0.0.1", port, False)
     finally:
         teardown_broker("svc", root=tmp_path)
 
@@ -131,6 +149,106 @@ def test_concurrent_ensure_waits_on_lock(tmp_path):
     finally:
         teardown_broker("first", root=tmp_path)
         (tmp_path / "broker" / "svc.json").unlink(missing_ok=True)
+
+
+def test_lock_wait_path_applies_advertise_rewrite(tmp_path):
+    """A caller that loses the spawn race but passes its own advertise
+    address must get that address back (and recorded) — not the winner's.
+    Same contract as the uncontended reuse path."""
+    import threading
+    import time as _time
+
+    lock = tmp_path / "broker" / "svc.lock"
+    lock.parent.mkdir(parents=True)
+    lock.write_text(str(os.getpid()))
+    results = {}
+
+    def second():
+        results["out"] = ensure_broker(
+            "svc", root=tmp_path, advertise="10.7.7.7", timeout_s=10
+        )
+
+    t = threading.Thread(target=second)
+    t.start()
+    _time.sleep(0.3)
+    host, port, _ = ensure_broker("first", root=tmp_path)
+    try:
+        rec = tmp_path / "broker" / "svc.json"
+        rec.write_text(
+            json.dumps(
+                {"cluster": "svc", "host": "127.0.0.1", "port": port,
+                 "pid": json.loads((tmp_path / "broker" / "first.json").read_text())["pid"]}
+            )
+        )
+        lock.unlink()
+        t.join(timeout=15)
+        assert not t.is_alive()
+        assert results["out"] == ("10.7.7.7", port, False)
+        assert json.loads(rec.read_text())["host"] == "10.7.7.7"
+    finally:
+        teardown_broker("first", root=tmp_path)
+        (tmp_path / "broker" / "svc.json").unlink(missing_ok=True)
+
+
+def test_bind_addresses_scope():
+    """The broker is never handed an all-interfaces bind: loopback only
+    for the local backend; loopback + advertise (+ the host's outbound
+    interface for non-local advertise addresses) otherwise."""
+    from deeplearning_cfn_tpu.cluster.broker_service import (
+        _bind_addresses,
+        detect_host_ip,
+    )
+
+    assert _bind_addresses(None) == "127.0.0.1"
+    assert _bind_addresses("127.0.0.1") == "127.0.0.1"
+    host_ip = detect_host_ip()
+    addrs = _bind_addresses("203.0.113.9").split(",")
+    assert addrs[0] == "127.0.0.1"
+    assert "203.0.113.9" in addrs
+    assert "*" not in addrs and "0.0.0.0" not in addrs
+    if host_ip != "127.0.0.1":
+        assert host_ip in addrs
+
+
+def test_broker_binary_skips_unbindable_address(tmp_path):
+    """The binary binds what it can from the list and serves: a NAT/public
+    advertise address that is not a local interface must not be fatal."""
+    import subprocess
+    import time as _time
+
+    from deeplearning_cfn_tpu.cluster.broker_client import BROKER_BIN, build_broker
+
+    build_broker()
+    log_path = tmp_path / "b.log"
+    with open(log_path, "wb") as fh:
+        proc = subprocess.Popen(
+            [str(BROKER_BIN), "0", "127.0.0.1,203.0.113.9"],
+            stdout=fh, stderr=subprocess.STDOUT,
+        )
+    try:
+        deadline = _time.monotonic() + 10
+        port = None
+        while _time.monotonic() < deadline and port is None:
+            text = log_path.read_text(errors="replace")
+            m = re.search(r"listening on (\d+)", text)
+            if m:
+                port = int(m.group(1))
+                break
+            _time.sleep(0.05)
+        assert port, log_path.read_text(errors="replace")
+        assert "skipping unbindable address 203.0.113.9" in log_path.read_text(
+            errors="replace"
+        )
+        from deeplearning_cfn_tpu.cluster.broker_client import BrokerConnection
+
+        conn = BrokerConnection("127.0.0.1", port, timeout_s=2)
+        try:
+            assert conn.ping()
+        finally:
+            conn.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
 
 
 def test_stale_lock_from_dead_holder_is_reclaimed(tmp_path):

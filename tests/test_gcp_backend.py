@@ -81,11 +81,15 @@ def test_storage_create_and_retain():
     assert backend.delete_storage(handle.storage_id, force=True)
 
 
-def test_storage_reuse_before_create_and_legacy_adoption():
+def test_storage_reuse_before_create_and_no_legacy_probe():
     """Spec-derived storage ids are probed before creation (recreate after
-    delete-with-retain reuses the bucket), and ids derived before the
-    namespace change (no cluster name in the digest) are adopted instead
-    of orphaning their checkpoints."""
+    delete-with-retain reuses the bucket).  There is deliberately NO
+    un-namespaced legacy-id fallback: genuinely legacy ids were derived
+    from Python's randomized builtin hash() and can never be re-derived,
+    and a shared un-namespaced fallback would let every cluster sharing
+    project/zone/mount adopt the SAME resource — reintroducing the
+    cross-cluster --force-storage hazard the namespace prevents.  Legacy
+    resources are adopted explicitly via the spec's existing_id."""
     import hashlib
 
     transport = FakeGCPTransport()
@@ -99,14 +103,17 @@ def test_storage_reuse_before_create_and_legacy_adoption():
     h2 = backend.create_or_reuse_storage("gcs", None, "/mnt/dlcfn", True)
     assert h2.created is False and h2.storage_id == h1.storage_id
 
-    # Legacy (pre-namespace) bucket exists; namespaced id does not ->
-    # adopt the legacy one.
-    # Legacy format: project/zone/mount joined with "/" (mount keeps its
-    # leading slash, hence the double slash).
-    legacy_digest = hashlib.sha256(
+    # An un-namespaced-digest bucket exists; the namespaced id does not.
+    # A fresh namespaced bucket is created — the shared id is never
+    # silently adopted.
+    unnamespaced_digest = hashlib.sha256(
         f"{backend.project}/{backend.zone}//mnt/other".encode()
     ).hexdigest()[:6]
-    legacy_id = f"dlcfn-gcs-{legacy_digest}"
-    transport.buckets.add(legacy_id)
+    shared_id = f"dlcfn-gcs-{unnamespaced_digest}"
+    transport.buckets.add(shared_id)
     h3 = backend.create_or_reuse_storage("gcs", None, "/mnt/other", True)
-    assert h3.created is False and h3.storage_id == legacy_id
+    assert h3.created is True and h3.storage_id != shared_id
+
+    # Explicit adoption path for genuinely legacy resources.
+    h4 = backend.create_or_reuse_storage("gcs", shared_id, "/mnt/other", True)
+    assert h4.created is False and h4.storage_id == shared_id
