@@ -580,7 +580,8 @@ def cmd_convert(args) -> int:
             )
         elif args.format == "imagefolder":
             out = datasets.convert_imagefolder(
-                args.src, args.out, size=args.size, split=args.split
+                args.src, args.out, size=args.size, split=args.split,
+                margin=args.margin,
             )
         elif args.format == "coco":
             if not args.annotations:
@@ -767,6 +768,11 @@ def main(argv: list[str] | None = None) -> int:
     pc.add_argument("--out", required=True, help="output dir for .dlc files")
     pc.add_argument("--size", type=int, default=224,
                     help="image size for imagefolder/coco records")
+    pc.add_argument("--margin", type=int, default=0,
+                    help="imagefolder: extra pixels stored per side so "
+                         "training can random-crop --size windows "
+                         "(convert train splits with e.g. --margin 32; "
+                         "eval splits with 0)")
     pc.add_argument("--split", default="train",
                     help="output split name for imagefolder/coco")
     pc.add_argument("--annotations", default=None,

@@ -142,13 +142,21 @@ def ensure_broker(
         if advertise is not None and advertise != host:
             # Records from before binds were narrowed carry no bind list;
             # those brokers bound all interfaces, so any rewrite is safe.
-            bound = set(str(record.get("binds", "*")).split(","))
+            # The comparison is against the REQUESTED set (what the old
+            # broker attempted), not the actual binds: an address the old
+            # broker already tried and found unbindable (a NAT advertise)
+            # would fail again after a restart — comparing against actual
+            # binds would restart on every reuse, forever.
+            attempted = set(
+                str(record.get("binds_requested", record.get("binds", "*"))).split(",")
+            )
             needed = set(_bind_addresses(advertise).split(","))
-            if "*" not in bound and not needed <= bound:
+            if "*" not in attempted and not needed <= attempted:
                 log.warning(
                     "advertise %s needs interfaces the live broker never "
-                    "bound (%s); restarting it with the wider bind set",
-                    advertise, ",".join(sorted(bound)),
+                    "attempted to bind (%s); restarting it with the wider "
+                    "bind set",
+                    advertise, ",".join(sorted(attempted)),
                 )
                 return None
             log.warning(
@@ -311,6 +319,29 @@ def ensure_broker(
             raise BrokerError("broker did not become reachable")
 
         host = advertise or "127.0.0.1"
+        # Record what the broker ACTUALLY listens on, not what was
+        # requested: the binary skips unbindable addresses (NAT IPs,
+        # port conflicts on one interface) non-fatally and logs each.
+        # Recording the requested list would let a later advertise
+        # rewrite pass the needed<=bound safety check against addresses
+        # nothing serves.
+        requested = _bind_addresses(advertise).split(",")
+        skipped = set(
+            re.findall(
+                r"skipping unbindable address (\S+)",
+                log_path.read_text(errors="replace"),
+            )
+        )
+        actual_binds = [a for a in requested if a not in skipped]
+        if advertise and advertise in skipped:
+            # Expected for a NAT/public advertise address (traffic arrives
+            # at the host's own interface, which is bound); surfaced so a
+            # port conflict on a LOCAL advertise interface is not silent.
+            log.warning(
+                "advertise address %s is not locally bindable; VMs must "
+                "reach the broker via forwarding to one of: %s",
+                advertise, ",".join(actual_binds),
+            )
         rec.write_text(
             json.dumps(
                 {
@@ -318,10 +349,13 @@ def ensure_broker(
                     "host": host,
                     "port": bound_port,
                     "pid": proc.pid,
-                    # What the broker actually listens on — consulted on
-                    # reuse so an advertise rewrite never hands VMs an
-                    # address nothing is bound to.
-                    "binds": _bind_addresses(advertise),
+                    # What the broker actually listens on (skips removed)
+                    # vs what was attempted: reuse compares advertise needs
+                    # against ATTEMPTED (retrying a known-unbindable NAT
+                    # address is pointless), while the actual list is the
+                    # honest record of what serves.
+                    "binds": ",".join(actual_binds),
+                    "binds_requested": ",".join(requested),
                     "started_ts": time.time(),
                 }
             )

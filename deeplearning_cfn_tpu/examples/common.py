@@ -141,6 +141,27 @@ def base_parser(description: str) -> argparse.ArgumentParser:
     return p
 
 
+def make_lr_schedule(args, base_lr: float, total_steps: int | None = None):
+    """The convergence-recipe seam: --lr_schedule/--warmup_steps/
+    --lr_boundaries/--lr_decay_factor -> an optax schedule for
+    ``TrainerConfig.lr_schedule`` (None = constant, the flag default).
+    The reference's flagship trains on exactly the stepped shape
+    (run.sh:93); cosine is the modern default for the rest."""
+    from deeplearning_cfn_tpu.train.schedules import build_schedule
+
+    boundaries = None
+    if getattr(args, "lr_boundaries", None):
+        boundaries = [int(b) for b in str(args.lr_boundaries).split(",") if b]
+    return build_schedule(
+        getattr(args, "lr_schedule", "constant"),
+        base_lr,
+        total_steps or args.steps,
+        warmup_steps=getattr(args, "warmup_steps", None),
+        boundaries=boundaries,
+        decay_factor=getattr(args, "lr_decay_factor", 0.1),
+    )
+
+
 def has_heldout_split(data_dir: str | None) -> bool:
     """Whether --data_dir contains a test/val/heldout record file — i.e.
     eval_mode batches will be genuinely held out rather than an unshuffled
@@ -208,12 +229,39 @@ def record_paths(data_dir: str, eval_mode: bool = False):
     return root, paths
 
 
+def resume_start_step(ckpt) -> int:
+    """The data-stream resume position for a (possibly None) Checkpointer:
+    the restored run must consume the batches the lost run never saw, not
+    replay the head of the shuffle order.  One batch per step, so the
+    loader position IS the checkpoint step."""
+    if ckpt is None:
+        return 0
+    return int(ckpt.latest_step() or 0)
+
+
+def open_checkpointer(args):
+    """(checkpointer_or_None, start_step) for --checkpoint_dir — the ONE
+    resume-wiring helper every example uses.  The ordering it encodes is
+    load-bearing: the checkpoint's latest step must be read BEFORE the
+    data loader is built (it is the loader's start_batch), and the state
+    itself is restored later, after trainer.init provides the template.
+    Hand-rolling this per example risks silently reintroducing the
+    shuffle-replay bug (VERDICT r3 weak #1)."""
+    if not getattr(args, "checkpoint_dir", None):
+        return None, 0
+    from deeplearning_cfn_tpu.train.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(args.checkpoint_dir)
+    return ckpt, resume_start_step(ckpt)
+
+
 def token_record_loader(
     args,
     batch: int,
     model_vocab_size: int,
     eval_mode: bool = False,
     reserve_ids: int = 0,
+    start_step: int = 0,
 ):
     """Shared ingestion for token DLC1 records (``dlcfn convert --format
     text``): returns ``(loader, spec, data_vocab)`` or None when
@@ -256,11 +304,16 @@ def token_record_loader(
         shuffle=not eval_mode,
         loop=not eval_mode,
         n_threads=1 if (eval_mode or jax.process_count() > 1) else 4,
+        # Resume: continue the stream at the restored step (train only —
+        # eval is always a fresh single pass).
+        start_batch=0 if eval_mode else start_step,
     )
     return loader, spec, data_vocab
 
 
-def image_pipeline(args, image_shape, fallback_ds, eval_mode: bool = False):
+def image_pipeline(
+    args, image_shape, fallback_ds, eval_mode: bool = False, start_step: int = 0
+):
     """(batches_fn, input_stats) for an image trainer: DLC1 records
     through the native loader when ``--data_dir`` is set (first existing
     candidate dir wins, the run.sh:21-35 data-source probe), else the
@@ -292,14 +345,29 @@ def image_pipeline(args, image_shape, fallback_ds, eval_mode: bool = False):
 
     root, paths = record_paths(args.data_dir, eval_mode)
     batch = args.global_batch_size or fallback_ds.batch_size
-    # Records may be float32 (synthetic staging) or uint8 (real-dataset
-    # converters, train/datasets.py); the file header disambiguates.
+    # Records may be float32 (synthetic staging), uint8 at the model's
+    # input size (real-dataset converters, train/datasets.py), or uint8
+    # LARGER than it (margin-converted for random-crop augmentation);
+    # the file header disambiguates all three.
     record_size, _ = read_header(paths[0])
     spec = RecordSpec.classification(image_shape)
     u8_spec = RecordSpec.classification(image_shape, "uint8")
     is_u8 = record_size == u8_spec.record_size != spec.record_size
+    margin_spec = None
     if is_u8:
         spec = u8_spec
+    elif record_size != spec.record_size:
+        # Margin records identify themselves via the explicit layout
+        # sidecar the converter writes — NEVER inferred from record_size
+        # (a float32 record of side S is byte-identical to uint8 of side
+        # 2S; inference would silently train on reinterpreted garbage).
+        # No sidecar -> fall through to the loader's loud size mismatch.
+        from deeplearning_cfn_tpu.train.datasets import margin_spec_from_layout
+
+        margin_spec = margin_spec_from_layout(paths[0], record_size, image_shape)
+        if margin_spec is not None:
+            spec = margin_spec
+            is_u8 = True
     multi = jax.process_count() > 1
     loader = NativeRecordLoader(
         paths,
@@ -310,12 +378,17 @@ def image_pipeline(args, image_shape, fallback_ds, eval_mode: bool = False):
         # >1 reader threads deliver batches out of order; fine on one
         # host, divergent across hosts.
         n_threads=1 if (multi or eval_mode) else 4,
+        # Resume: continue the stream at the restored step (train only —
+        # eval is always a fresh single pass).
+        start_batch=0 if eval_mode else start_step,
     )
     log.info(
-        "data%s: %d record files under %s (%d records, %d batches/epoch%s)",
+        "data%s: %d record files under %s (%d records, %d batches/epoch%s%s)",
         " [eval]" if eval_mode else "", len(paths), root,
         loader.shard_records, loader.batches_per_epoch,
         ", uint8 (in-step normalize)" if is_u8 else "",
+        f", stored {spec.fields[0].shape[0]}px (crop to {image_shape[0]})"
+        if margin_spec is not None else "",
     )
     if not is_u8:
         return loader.batches, None
@@ -342,13 +415,39 @@ def image_pipeline(args, image_shape, fallback_ds, eval_mode: bool = False):
         stats = STATS[guess]
     input_stats = (tuple(stats.mean.tolist()), tuple(stats.std.tolist()))
     flip = bool(getattr(args, "augment_flip", False)) and not eval_mode
-    if not flip:
+    aug_crop = bool(getattr(args, "augment_crop", False)) and not eval_mode
+    crop_pad = int(getattr(args, "crop_pad", 4) or 0)
+    target_hw = (int(image_shape[0]), int(image_shape[1]))
+    if margin_spec is None and not aug_crop and not flip:
         return loader.batches, input_stats
-    from deeplearning_cfn_tpu.train.datasets import flipped_batches
+    from deeplearning_cfn_tpu.train.datasets import (
+        center_crop_batches,
+        flipped_batches,
+        random_crop_batches,
+    )
 
     def batches(steps):
-        # copy=True: the loader's decode reuses buffers batch-to-batch.
-        return flipped_batches(loader.batches(steps), copy=True)
+        stream = loader.batches(steps)
+        cropped = True
+        if margin_spec is not None:
+            # Margin records MUST be cropped to the model's input size;
+            # augmentation decides random-vs-center, eval is always
+            # deterministic.
+            if eval_mode or not aug_crop:
+                stream = center_crop_batches(stream, target_hw)
+            else:
+                stream = random_crop_batches(stream, target_hw)
+        elif aug_crop:
+            # Same-size records: the classic pad-and-crop recipe.
+            stream = random_crop_batches(stream, target_hw, pad=crop_pad)
+        else:
+            cropped = False
+        if flip:
+            # Crop outputs are freshly allocated (in-place flip safe);
+            # un-cropped streams come straight from the decoder, copy
+            # defensively.
+            stream = flipped_batches(stream, copy=not cropped)
+        return stream
 
     return batches, input_stats
 

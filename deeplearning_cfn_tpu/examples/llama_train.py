@@ -18,19 +18,22 @@ import jax.numpy as jnp
 from deeplearning_cfn_tpu.examples.common import base_parser, maybe_init_distributed
 from deeplearning_cfn_tpu.models import llama
 from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
-from deeplearning_cfn_tpu.train.checkpoint import Checkpointer
 from deeplearning_cfn_tpu.train.data import SyntheticTokenDataset
 from deeplearning_cfn_tpu.examples.common import metrics_sink
 from deeplearning_cfn_tpu.train.trainer import TrainerConfig
 
 
-def token_record_batches(args, cfg, batch: int, eval_mode: bool = False):
+def token_record_batches(
+    args, cfg, batch: int, eval_mode: bool = False, start_step: int = 0
+):
     """Token DLC1 records (``dlcfn convert --format text``) as causal-LM
     batches when --data_dir is set; None = synthetic."""
     from deeplearning_cfn_tpu.examples.common import token_record_loader
     from deeplearning_cfn_tpu.train.datasets import token_batches
 
-    loaded = token_record_loader(args, batch, cfg.vocab_size, eval_mode)
+    loaded = token_record_loader(
+        args, batch, cfg.vocab_size, eval_mode, start_step=start_step
+    )
     if loaded is None:
         return None
     loader, spec, _ = loaded
@@ -42,12 +45,15 @@ def main(argv: list[str] | None = None) -> dict:
 
     t_main = first_step_clock()
     p = base_parser(__doc__)
-    p.add_argument("--size", choices=["tiny", "435m", "8b"], default="tiny")
+    p.add_argument("--size", choices=["tiny", "435m", "1b", "8b"], default="tiny")
     p.add_argument("--seq_len", type=int, default=512)
     p.add_argument("--fsdp", type=int, default=None, help="fsdp axis size (default: all devices)")
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--ring_attention", action="store_true")
+    p.add_argument("--fused_qkv", action="store_true",
+                   help="fuse q/k/v and gate/up projections into single "
+                        "wider matmuls (measured lever, BENCH_NOTES r4)")
     p.add_argument("--pp", type=int, default=1, help="pipeline stages (GPipe)")
     p.add_argument("--pp_microbatches", type=int, default=0)
     p.add_argument("--experts", type=int, default=0, help="MoE experts (0 = dense)")
@@ -67,12 +73,16 @@ def main(argv: list[str] | None = None) -> dict:
 
     if args.size == "8b":
         cfg = llama.LlamaConfig.llama3_8b()
+    elif args.size == "1b":
+        cfg = llama.LlamaConfig.b1(seq_len=args.seq_len)
     elif args.size == "435m":
         cfg = llama.LlamaConfig.m435(seq_len=args.seq_len)
     else:
         cfg = llama.LlamaConfig.tiny(vocab_size=512, seq_len=args.seq_len)
     if args.ring_attention:
         cfg = dataclasses.replace(cfg, use_ring_attention=True)
+    if args.fused_qkv:
+        cfg = dataclasses.replace(cfg, fused_qkv=True)
     if args.experts:
         cfg = dataclasses.replace(cfg, n_experts=args.experts)
     if pp > 1:
@@ -85,13 +95,20 @@ def main(argv: list[str] | None = None) -> dict:
     # default to batch 1 and fail microbatch splitting).
     microbatches = (args.pp_microbatches or pp) if pp > 1 else 1
     batch = args.global_batch_size or max(1, dp * fsdp) * microbatches
+    from deeplearning_cfn_tpu.examples.common import make_lr_schedule
+
+    lr = args.learning_rate or 3e-4
     trainer = llama.make_trainer(
         cfg,
         mesh,
         TrainerConfig(
             strategy="fsdp",
             optimizer="adamw",
-            learning_rate=args.learning_rate or 3e-4,
+            learning_rate=lr,
+            # --lr_schedule cosine = the standard LM recipe (linear
+            # warmup + cosine decay); default stays constant so short
+            # benchmark runs are comparable across rounds.
+            lr_schedule=make_lr_schedule(args, lr),
             weight_decay=0.1,
             grad_clip_norm=1.0,
             log_every=args.log_every,
@@ -100,12 +117,16 @@ def main(argv: list[str] | None = None) -> dict:
     ds = SyntheticTokenDataset(
         seq_len=args.seq_len, vocab_size=cfg.vocab_size, batch_size=batch
     )
-    batches = token_record_batches(args, cfg, batch) or ds.batches
+    from deeplearning_cfn_tpu.examples.common import open_checkpointer
+
+    ckpt, start_step = open_checkpointer(args)
+    batches = (
+        token_record_batches(args, cfg, batch, start_step=start_step)
+        or ds.batches
+    )
     sample = next(iter(batches(1)))
     state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
-    ckpt = None
-    if args.checkpoint_dir:
-        ckpt = Checkpointer(args.checkpoint_dir)
+    if ckpt is not None:
         restored = ckpt.restore_latest(state)
         if restored is not None:
             state, _ = restored

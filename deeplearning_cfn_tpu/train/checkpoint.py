@@ -80,6 +80,14 @@ class Checkpointer:
         self._last_save_t = time.monotonic()
         log.info("checkpoint saved at step %d -> %s", step, self.directory)
 
+    def latest_step(self) -> int | None:
+        """The newest checkpoint's step without restoring — available
+        before any state exists, which is exactly when the DATA position
+        must be decided: loaders take ``start_batch=latest_step()`` so a
+        resumed run continues the record stream instead of replaying the
+        head of the shuffle order."""
+        return self._manager.latest_step()
+
     def restore_latest(self, abstract_state: Any) -> tuple[Any, int] | None:
         """Restore the newest checkpoint into the given abstract state
         (shape/sharding template — pass jax.eval_shape output or a live

@@ -175,22 +175,51 @@ def center_crop_batches(
         yield Batch(x=x[:, top : top + th, left : left + tw].copy(), y=b.y)
 
 
-def inferred_margin_spec(
-    record_size: int, image_shape: Sequence[int]
-) -> RecordSpec | None:
-    """The RecordSpec of a margin-converted record file: a LARGER square
-    uint8 image with the same channel count as ``image_shape`` (plus the
-    int32 label).  None when ``record_size`` doesn't decode to one."""
-    import math
+def write_layout_sidecar(
+    out_dir: str | Path, split: str, image_px: int, channels: int
+) -> None:
+    """``<split>.layout.json`` next to the records: pins the stored image
+    geometry/dtype explicitly.  Margin-converted records are LARGER than
+    the model's input, and guessing the layout from record_size alone is
+    ambiguous — a float32 record of side S has exactly the byte count of
+    a uint8 record of side 2S, so inference would silently train on
+    reinterpreted garbage where an explicit contract raises."""
+    Path(out_dir).mkdir(parents=True, exist_ok=True)
+    (Path(out_dir) / f"{split}.layout.json").write_text(
+        json.dumps({"image_px": image_px, "channels": channels, "dtype": "uint8"})
+    )
 
-    c = int(image_shape[-1])
-    payload = record_size - 4  # int32 label
-    if payload <= 0 or payload % c:
+
+def read_layout_sidecar(record_path: str | Path) -> dict | None:
+    """The layout sidecar for one ``.dlc`` file (same stem), or None."""
+    try:
+        return json.loads(
+            Path(record_path).with_suffix("").with_suffix(".layout.json").read_text()
+        )
+    except (FileNotFoundError, json.JSONDecodeError):
         return None
-    side = math.isqrt(payload // c)
-    if side * side * c != payload or side < max(image_shape[0], image_shape[1]):
+
+
+def margin_spec_from_layout(
+    record_path: str | Path, record_size: int, image_shape: Sequence[int]
+) -> RecordSpec | None:
+    """RecordSpec for a margin-converted record file, built ONLY from its
+    explicit layout sidecar (never inferred from record_size — see
+    write_layout_sidecar).  None unless the sidecar exists, matches the
+    file's record_size exactly, and is at least the model's input size."""
+    layout = read_layout_sidecar(record_path)
+    if not layout or layout.get("dtype") != "uint8":
         return None
-    return RecordSpec.classification((side, side, c), "uint8")
+    side = int(layout.get("image_px", 0))
+    channels = int(layout.get("channels", 0))
+    if channels != int(image_shape[-1]):
+        return None
+    if side < max(int(image_shape[0]), int(image_shape[1])):
+        return None
+    spec = RecordSpec.classification((side, side, channels), "uint8")
+    if spec.record_size != record_size:
+        return None
+    return spec
 
 
 def normalized_batches(
@@ -388,6 +417,7 @@ def convert_imagefolder(
     n = write_records(out_dir / f"{split}.dlc", spec, gen())
     (out_dir / "classes.json").write_text(json.dumps(classes))
     write_stats_sidecar(out_dir, "imagenet", IMAGENET_MEAN, IMAGENET_STD)
+    write_layout_sidecar(out_dir, split, stored, 3)
     log.info("imagefolder %s: %d records (%d classes, stored %dpx) -> %s",
              split, n, len(classes), stored, out_dir)
     return {

@@ -66,6 +66,7 @@ def _load_library() -> ctypes.CDLL:
         ctypes.c_int,  # drop_remainder
         ctypes.c_int,  # loop
         ctypes.c_uint64,  # seed
+        ctypes.c_uint64,  # start_batch
         ctypes.c_char_p,  # err_out
         ctypes.c_int,  # err_cap
     ]
@@ -104,6 +105,14 @@ class NativeRecordLoader:
     drop_remainder: bool = True
     loop: bool = True
     seed: int = 0
+    # Resume position: the global batch index (across epochs) to start
+    # at — one batch per training step, so a run restored at step N
+    # passes start_batch=N and the stream continues where the lost run
+    # stopped instead of replaying the head of the shuffle order (which
+    # over-weights early records and may never reach the tail).  Every
+    # epoch's permutation is a pure function of (seed, epoch), so the
+    # position is exactly reproducible in a fresh process.
+    start_batch: int = 0
     _handle: int | None = field(default=None, repr=False)
 
     def __post_init__(self) -> None:
@@ -131,6 +140,7 @@ class NativeRecordLoader:
             int(self.drop_remainder),
             int(self.loop),
             self.seed,
+            self.start_batch,
             err,
             len(err),
         )

@@ -61,16 +61,31 @@ def stepped(
     warmup_steps: int = 0,
 ) -> optax.Schedule:
     """base_lr, multiplied by ``decay_factor`` at each boundary step —
-    the reference's LR_SCHEDULE shape — with optional linear warmup."""
+    the reference's LR_SCHEDULE shape — with optional linear warmup.
+
+    ``boundaries`` are ABSOLUTE step indices (what --lr_boundaries and
+    default_step_boundaries document), so with warmup the piecewise
+    child's boundaries are shifted down by ``warmup_steps``:
+    optax.join_schedules re-zeroes the step it passes to later children,
+    and without the shift every decay would land ``warmup_steps`` late.
+    """
     if not boundaries:
         raise ValueError("stepped schedule needs at least one boundary")
     if sorted(boundaries) != list(boundaries):
         raise ValueError(f"boundaries must be increasing, got {boundaries}")
-    piecewise = optax.piecewise_constant_schedule(
-        base_lr, {int(b): decay_factor for b in boundaries}
-    )
     if warmup_steps <= 0:
-        return piecewise
+        return optax.piecewise_constant_schedule(
+            base_lr, {int(b): decay_factor for b in boundaries}
+        )
+    if boundaries[0] <= warmup_steps:
+        raise ValueError(
+            f"first decay boundary {boundaries[0]} must come after "
+            f"warmup_steps={warmup_steps} (boundaries are absolute step "
+            "indices)"
+        )
+    piecewise = optax.piecewise_constant_schedule(
+        base_lr, {int(b) - warmup_steps: decay_factor for b in boundaries}
+    )
     warmup = optax.linear_schedule(0.0, base_lr, warmup_steps)
     return optax.join_schedules([warmup, piecewise], [warmup_steps])
 
@@ -104,9 +119,23 @@ def build_schedule(
         warmup_steps = min(1000, max(0, total_steps // 20)) if kind == "cosine" else 0
     if kind == "cosine":
         return warmup_cosine(base_lr, total_steps, warmup_steps)
+    bounds = list(boundaries) if boundaries else default_step_boundaries(total_steps)
+    # The builder clamps an over-long warmup into the run instead of
+    # raising (stepped() itself stays strict): a production recipe sized
+    # for the full run must also execute at smoke-test scale, where
+    # "5 epochs of warmup" can exceed the whole shrunken budget.
+    max_warmup = min(max(0, bounds[0] - 1), max(0, total_steps - 1))
+    if warmup_steps > max_warmup:
+        import logging
+
+        logging.getLogger("dlcfn.schedules").warning(
+            "clamping warmup_steps %d -> %d (first decay boundary %d, "
+            "total_steps %d)", warmup_steps, max_warmup, bounds[0], total_steps,
+        )
+        warmup_steps = max_warmup
     return stepped(
         base_lr,
-        list(boundaries) if boundaries else default_step_boundaries(total_steps),
+        bounds,
         decay_factor=decay_factor,
         warmup_steps=warmup_steps,
     )

@@ -215,10 +215,16 @@ void worker_main(Loader* L) {
 extern "C" {
 
 // Returns an opaque handle or null. paths: n null-terminated strings.
+// start_batch: global batch index (across epochs) to begin at — the
+// resume-from-checkpoint data position.  The permutation of any epoch is
+// a pure function of (seed, epoch), so position (seed, start_batch) is
+// exactly reproducible: a loader opened at start_batch=K yields the same
+// stream a fresh loader yields after K batches (single-reader order).
 void* dlcfn_loader_open(const char** paths, int n_paths, int batch_size,
                         int n_threads, int shard_index, int shard_count,
                         int shuffle, int drop_remainder, int loop,
-                        uint64_t seed, char* err_out, int err_cap) {
+                        uint64_t seed, uint64_t start_batch,
+                        char* err_out, int err_cap) {
   auto fail = [&](const std::string& msg) -> void* {
     if (err_out && err_cap > 0) {
       snprintf(err_out, err_cap, "%s", msg.c_str());
@@ -279,7 +285,13 @@ void* dlcfn_loader_open(const char** paths, int n_paths, int batch_size,
     L->n_batches_per_epoch =
         (L->total_records + L->batch_size - 1) / L->batch_size;
   }
-  L->epoch = 0;
+  // Resume position: tickets resume at the global batch index, the
+  // epoch counter and intra-epoch emission count follow, and the
+  // permutation is regenerated for THAT epoch (reshuffle is stateless in
+  // everything but (seed, epoch)).
+  L->next_ticket = start_batch;
+  L->epoch = start_batch / L->n_batches_per_epoch;
+  L->batches_emitted_this_epoch = start_batch % L->n_batches_per_epoch;
   reshuffle(L);
   if (n_threads < 1) n_threads = 1;
   L->max_ready = (size_t)std::max(4, n_threads * 2);

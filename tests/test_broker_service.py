@@ -94,8 +94,20 @@ def test_reuse_rewrites_advertise_address(tmp_path):
         assert (host2, started2) == ("10.9.9.9", True)
         rec = json.loads((tmp_path / "broker" / "svc.json").read_text())
         assert rec["host"] == "10.9.9.9"
-        assert "10.9.9.9" in rec["binds"].split(",")
+        # The unroutable test address cannot actually bind here: it is
+        # recorded as ATTEMPTED (so reuse does not restart-loop on it)
+        # but never as an actual bind; the host's own interface is what
+        # serves the forwarded traffic.
+        assert "10.9.9.9" in rec["binds_requested"].split(",")
+        assert "10.9.9.9" not in rec["binds"].split(",")
         assert broker_status("svc", root=tmp_path)["alive"] is True
+
+        # A third ensure with the SAME advertise reuses — no restart loop
+        # on a permanently-unbindable advertise address.
+        host3, port3, started3 = ensure_broker(
+            "svc", root=tmp_path, advertise="10.9.9.9"
+        )
+        assert (host3, port3, started3) == ("10.9.9.9", port2, False)
     finally:
         teardown_broker("svc", root=tmp_path)
 

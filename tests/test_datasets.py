@@ -148,6 +148,20 @@ def test_imagefolder_conversion(tmp_path):
     ]
 
 
+def test_imagefolder_margin_conversion(tmp_path):
+    """margin > 0 stores (size+margin)-square records — the raw material
+    for random-crop augmentation (fixed-shape records, fresh windows
+    every epoch)."""
+    write_imagefolder_fixture(tmp_path / "src")
+    out = datasets.convert_imagefolder(
+        tmp_path / "src", tmp_path / "dlc", size=32, split="train", margin=8
+    )
+    assert out["stored_px"] == 40
+    decoded = read_all(tmp_path / "dlc" / "train.dlc", datasets.imagefolder_spec(40))
+    assert decoded["x"].shape == (6, 40, 40, 3)
+    np.testing.assert_array_equal(decoded["y"], [0, 0, 0, 1, 1, 1])
+
+
 def test_coco_conversion_boxes_scaled_and_padded(tmp_path):
     img_dir, ann_path, images, annotations = write_coco_fixture(tmp_path)
     out = datasets.convert_coco(

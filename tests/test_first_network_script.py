@@ -19,6 +19,7 @@ import pytest
 from tests.test_datasets import (
     write_cifar10_fixture,
     write_coco_fixture,
+    write_imagefolder_fixture,
     write_mnist_fixture,
 )
 
@@ -40,11 +41,16 @@ def test_script_runs_all_stages_on_fixture_data(tmp_path):
         dest = "train" if i < 9 else "val"
         shutil.copy(img_dir / info["file_name"], src / "coco" / dest / info["file_name"])
     shutil.copy(ann_path, src / "coco" / "instances_val2017.json")
+    # ImageNet stage: the torchvision ImageFolder layout DLCFN_FNS_SRC
+    # must hold (ImageNet cannot be downloaded unauthenticated).
+    write_imagefolder_fixture(src / "imagenet" / "train", per_class=8)
+    write_imagefolder_fixture(src / "imagenet" / "val", per_class=4, seed=7)
 
     env = dict(
         os.environ,
         DLCFN_FNS_SRC=str(src),
         DLCFN_FNS_WORK=str(tmp_path / "work"),
+        DLCFN_FNS_DATASETS="cifar mnist coco imagenet",
         DLCFN_FNS_TARGET="0.05",  # reachable in a few steps on fixtures
         DLCFN_FNS_STEPS="12",
         DLCFN_FNS_DET_STEPS="2",
@@ -52,6 +58,11 @@ def test_script_runs_all_stages_on_fixture_data(tmp_path):
         DLCFN_FNS_BATCH="16",
         DLCFN_FNS_DET_BATCH="2",
         DLCFN_FNS_DET_BACKBONE="tiny",
+        DLCFN_FNS_IN_STEPS="2",
+        DLCFN_FNS_IN_BATCH="4",
+        DLCFN_FNS_IN_SIZE="32",
+        DLCFN_FNS_IN_MARGIN="8",
+        DLCFN_FNS_IN_TARGET="2.0",  # never reached: runs the full 2 steps
         PYTHON=sys.executable,
         JAX_PLATFORMS="cpu",
     )
@@ -76,3 +87,11 @@ def test_script_runs_all_stages_on_fixture_data(tmp_path):
     # COCO trained and produced an mAP eval.
     assert summary["coco"]["steps"] == 2
     assert "map50" in summary["coco"]["eval"] or "mAP" in str(summary["coco"]["eval"])
+    # ImageNet stage: margin records converted (stored = size + margin),
+    # the 76%-recipe trainer ran its target-accuracy loop with a held-out
+    # top-1 eval on the exact-size val split.
+    assert summary["convert_imagenet_train"]["stored_px"] == 40
+    assert summary["convert_imagenet_val"]["stored_px"] == 32
+    assert summary["imagenet"]["steps"] == 2
+    assert summary["imagenet"]["target_reached"] is False
+    assert "accuracy" in summary["imagenet"]["eval"]

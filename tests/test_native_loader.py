@@ -270,3 +270,54 @@ def test_image_batches_probes_and_loads(tmp_path):
     args4 = argparse.Namespace(data_dir=str(empty), global_batch_size=8)
     with pytest.raises(SystemExit, match="no .dlc"):
         image_batches(args4, (8, 8, 1), ds)
+
+
+def test_resume_continues_the_stream_exactly(tmp_path):
+    """start_batch=K reproduces what a fresh loader yields AFTER K
+    batches — the checkpoint-resume data position (one reader thread =
+    deterministic order).  Crosses an epoch boundary so the resumed
+    loader must regenerate epoch 1's permutation, not epoch 0's."""
+    path = _write(tmp_path, "a.dlc", range(32))  # 8 batches/epoch at 4
+    def read(start, n):
+        with NativeRecordLoader(
+            [path], SPEC, batch_size=4, n_threads=1, shuffle=True,
+            loop=True, seed=3, start_batch=start,
+        ) as loader:
+            return [b.y.tolist() for b in loader.batches(n)]
+
+    straight = read(0, 12)           # epoch 0 (8 batches) + 4 of epoch 1
+    resumed = read(5, 7)             # batches 5..11
+    assert resumed == straight[5:12]
+    # The tail genuinely crossed the boundary: epoch 1's batches differ
+    # from epoch 0's at the same intra-epoch index (different shuffle).
+    assert straight[8:12] != straight[0:4]
+
+
+def test_resume_mid_epoch_sees_unseen_records(tmp_path):
+    """The resumed stream completes the interrupted epoch: records the
+    first K batches never covered all appear before any repeat."""
+    path = _write(tmp_path, "a.dlc", range(32))
+    with NativeRecordLoader(
+        [path], SPEC, batch_size=4, n_threads=1, shuffle=True,
+        loop=True, seed=9,
+    ) as loader:
+        head = [b.y.tolist() for b in loader.batches(5)]
+    seen_head = {y for b in head for y in b}
+    with NativeRecordLoader(
+        [path], SPEC, batch_size=4, n_threads=1, shuffle=True,
+        loop=True, seed=9, start_batch=5,
+    ) as loader:
+        tail = [b.y.tolist() for b in loader.batches(3)]
+    seen_tail = {y for b in tail for y in b}
+    assert seen_head | seen_tail == set(range(32))
+    assert not (seen_head & seen_tail)
+
+
+def test_resume_without_shuffle(tmp_path):
+    path = _write(tmp_path, "a.dlc", range(16))
+    with NativeRecordLoader(
+        [path], SPEC, batch_size=4, n_threads=1, shuffle=False,
+        loop=True, start_batch=2,
+    ) as loader:
+        batch = next(iter(loader.batches(1)))
+    assert batch.y.tolist() == [8, 9, 10, 11]

