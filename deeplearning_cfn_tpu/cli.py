@@ -593,6 +593,7 @@ def cmd_convert(args) -> int:
                 size=args.size,
                 max_boxes=args.max_boxes,
                 split=args.split,
+                masks=args.masks_coco,
             )
         else:
             out = datasets.CONVERTERS[args.format](args.src, args.out)
@@ -778,6 +779,9 @@ def main(argv: list[str] | None = None) -> int:
     pc.add_argument("--annotations", default=None,
                     help="COCO instances_*.json path")
     pc.add_argument("--max-boxes", type=int, default=50, dest="max_boxes")
+    pc.add_argument("--masks", action="store_true", dest="masks_coco",
+                    help="coco: also rasterize instance-mask bitmaps into "
+                         "the records (for detection_train --masks)")
     pc.add_argument("--seq-len", type=int, default=2048, dest="seq_len",
                     help="token window length for --format text")
     pc.add_argument("--tokenizer", default=None,

@@ -59,7 +59,36 @@ def record_batches(args, batch: int, eval_mode: bool = False):
         paths = trains or paths
     if not paths:
         raise SystemExit(f"--data_dir: no .dlc record files under {root}")
-    spec = detection_spec(args.image_size, args.max_boxes)
+    from deeplearning_cfn_tpu.train.datasets import instance_spec
+
+    if getattr(args, "masks", False):
+        spec = instance_spec(args.image_size, args.max_boxes)
+    else:
+        spec = detection_spec(args.image_size, args.max_boxes)
+    # A clear mismatch message beats the loader's low-level size error:
+    # the most likely cause is records converted with the OTHER --masks
+    # setting (the mask bitmaps change the record layout).
+    from deeplearning_cfn_tpu.train.records import read_header
+
+    record_size, _ = read_header(paths[0])
+    if record_size != spec.record_size:
+        other = (
+            detection_spec(args.image_size, args.max_boxes)
+            if getattr(args, "masks", False)
+            else instance_spec(args.image_size, args.max_boxes)
+        )
+        hint = ""
+        if record_size == other.record_size:
+            hint = (
+                " — the records were converted with the opposite --masks "
+                "setting; re-run `dlcfn convert --format coco"
+                + (" --masks`" if getattr(args, "masks", False) else "` without --masks")
+            )
+        raise SystemExit(
+            f"{paths[0]}: record_size {record_size} != expected "
+            f"{spec.record_size} for --image_size {args.image_size} "
+            f"--max_boxes {args.max_boxes}{hint}"
+        )
     loader = NativeRecordLoader(
         paths,
         spec,
@@ -82,6 +111,16 @@ def main(argv: list[str] | None = None) -> dict:
     p.add_argument("--max_boxes", type=int, default=10)
     p.add_argument("--bf16", action=argparse.BooleanOptionalAction, default=True)
     p.add_argument("--freeze_backbone_norm", action="store_true")
+    p.add_argument("--masks", action="store_true",
+                   help="train the prototype-mask head too (instance "
+                        "segmentation, run.sh:86 MODE_MASK=True analog); "
+                        "records must be converted with `dlcfn convert "
+                        "--format coco --masks`")
+    p.add_argument("--backbone_ckpt", default=None,
+                   help="resnet_imagenet checkpoint dir: initialize the "
+                        "detector backbone from the trained classifier "
+                        "(run.sh:94 BACKBONE.WEIGHTS analog); depths must "
+                        "match --backbone")
     p.add_argument("--optimizer", choices=["momentum", "adamw"], default="momentum")
     p.add_argument("--eval_steps", type=int, default=0,
                    help="held-out batches for mAP@0.5 after training (0 = skip)")
@@ -98,6 +137,7 @@ def main(argv: list[str] | None = None) -> dict:
         backbone_stages=BACKBONES[args.backbone],
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         freeze_backbone_norm=args.freeze_backbone_norm,
+        with_masks=args.masks,
     )
     anchors = jnp.asarray(retinanet.generate_anchors(args.image_size))
 
@@ -105,15 +145,24 @@ def main(argv: list[str] | None = None) -> dict:
         variables = {"params": params, **model_state}
         mutable = list(model_state.keys())
         if mutable:
-            (cls_out, box_out), new_model_state = model.apply(
+            outputs, new_model_state = model.apply(
                 variables, x, train=True, mutable=mutable
             )
         else:
-            cls_out, box_out = model.apply(variables, x, train=True)
+            outputs = model.apply(variables, x, train=True)
             new_model_state = model_state
-        loss, aux = retinanet.detection_loss(
-            cls_out, box_out, anchors, y["boxes"], y["classes"], args.num_classes
-        )
+        if args.masks:
+            cls_out, box_out, coeff_out, protos = outputs
+            loss, aux = retinanet.detection_loss_with_masks(
+                cls_out, box_out, coeff_out, protos, anchors,
+                y["boxes"], y["classes"], y["masks"], args.num_classes,
+            )
+        else:
+            cls_out, box_out = outputs
+            loss, aux = retinanet.detection_loss(
+                cls_out, box_out, anchors, y["boxes"], y["classes"],
+                args.num_classes,
+            )
         return loss, (aux, new_model_state)
 
     trainer = Trainer(
@@ -134,10 +183,43 @@ def main(argv: list[str] | None = None) -> dict:
         num_classes=args.num_classes,
         max_boxes=args.max_boxes,
         batch_size=batch,
+        with_masks=args.masks,
     )
     batches = record_batches(args, batch) or ds.batches
     sample = next(iter(batches(1)))
     state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
+    if args.backbone_ckpt:
+        from pathlib import Path
+
+        from deeplearning_cfn_tpu.train.checkpoint import Checkpointer
+
+        # Existence check BEFORE constructing the Checkpointer: its ctor
+        # mkdirs the path, and a silently-created empty tree would make a
+        # mistyped --backbone_ckpt look real to later [ -d ] probes.
+        if not Path(args.backbone_ckpt).is_dir():
+            raise SystemExit(f"--backbone_ckpt: {args.backbone_ckpt} does not exist")
+        ck = Checkpointer(args.backbone_ckpt, async_save=False)
+        raw = ck.restore_raw()
+        ck.close()
+        if raw is None:
+            raise SystemExit(f"--backbone_ckpt: no checkpoint under {args.backbone_ckpt}")
+        new_params, new_model_state, n = retinanet.load_pretrained_backbone(
+            state.params, state.model_state, raw[0]
+        )
+        # Re-place on the mesh with the trainer's declared shardings: the
+        # jitted step's in_shardings must keep holding.
+        state = state.replace(
+            params=jax.device_put(new_params, trainer.state_shardings.params),
+            model_state=jax.device_put(
+                new_model_state, trainer.state_shardings.model_state
+            ),
+        )
+        from deeplearning_cfn_tpu.utils.logging import get_logger
+
+        get_logger("dlcfn.examples").info(
+            "backbone initialized from %s (step %d, %d tensors transferred)",
+            args.backbone_ckpt, raw[1], n,
+        )
     logger = trainer.throughput_logger(
         jnp.asarray(sample.x),
         examples_per_step=batch,
@@ -181,10 +263,20 @@ def evaluate_map(model, trainer, state, anchors, args, batch, steps: int) -> dic
         )
         return {}
 
+    with_masks = bool(getattr(args, "masks", False))
+
     @jax.jit
     def infer(params, model_state, x):
         variables = {"params": params, **model_state}
-        cls_out, box_out = model.apply(variables, x, train=False)
+        outputs = model.apply(variables, x, train=False)
+        if with_masks:
+            cls_out, box_out, coeff_out, protos = outputs
+            return jax.vmap(
+                lambda c, b, co, pr: retinanet.predict(
+                    c, b, anchors, max_detections=50, coeffs=co, protos=pr
+                )
+            )(cls_out, box_out, coeff_out, protos)
+        cls_out, box_out = outputs
         return jax.vmap(
             lambda c, b: retinanet.predict(c, b, anchors, max_detections=50)
         )(cls_out, box_out)
@@ -194,10 +286,15 @@ def evaluate_map(model, trainer, state, anchors, args, batch, steps: int) -> dic
         held_out = SyntheticDetectionDataset(
             image_size=args.image_size, num_classes=args.num_classes,
             max_boxes=args.max_boxes, batch_size=batch,
-            seed=7_000, template_seed=0,
+            seed=7_000, template_seed=0, with_masks=with_masks,
         )
         eval_batches = held_out.batches
     acc = DetectionAccumulator(num_classes=args.num_classes)
+    mask_acc = (
+        DetectionAccumulator(num_classes=args.num_classes, iou_kind="mask")
+        if with_masks
+        else None
+    )
     for batch_data in eval_batches(steps):
         x = jax.device_put(batch_data.x, trainer.batch_sharding)
         with jax.set_mesh(trainer.mesh):
@@ -208,9 +305,21 @@ def evaluate_map(model, trainer, state, anchors, args, batch, steps: int) -> dic
                 dets["valid"][i], batch_data.y["boxes"][i],
                 batch_data.y["classes"][i],
             )
+            if mask_acc is not None:
+                mask_acc.add_image(
+                    dets["boxes"][i], dets["scores"][i], dets["classes"][i],
+                    dets["valid"][i], batch_data.y["boxes"][i],
+                    batch_data.y["classes"][i],
+                    pred_masks=dets["masks"][i],
+                    gt_masks=batch_data.y["masks"][i],
+                )
     out = acc.result()
     # per_class_ap keys to str for JSON friendliness
     out["per_class_ap"] = {str(k): v for k, v in out["per_class_ap"].items()}
+    if mask_acc is not None:
+        m = mask_acc.result()
+        out["mask_mAP"] = m["mAP"]
+        out["mask_per_class_ap"] = {str(k): v for k, v in m["per_class_ap"].items()}
     return out
 
 

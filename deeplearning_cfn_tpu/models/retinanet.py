@@ -134,12 +134,15 @@ def match_anchors(
     gt_classes: jnp.ndarray,
     fg_iou: float = 0.5,
     bg_iou: float = 0.4,
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Per-anchor targets from padded ground truth (one image).
 
     ``gt_boxes`` [M, 4] padded with zeros; ``gt_classes`` [M] padded with -1.
     Returns (cls_target [N] in {-2 ignore, -1 background, 0..K-1},
-    box_target [N, 4], fg_mask [N]).
+    box_target [N, 4], fg_mask [N], best_gt [N] index into the padded
+    ground truth — meaningful only where fg — and best_iou [N]; the last
+    two feed the mask loss's fixed-budget positive selection, returned
+    here so matching semantics live in exactly one place).
     """
     valid = gt_classes >= 0
     iou = box_iou(anchors, gt_boxes) * valid[None, :].astype(jnp.float32)
@@ -151,7 +154,7 @@ def match_anchors(
     cls_target = jnp.where(fg, matched_class, -1)
     cls_target = jnp.where(ignore, -2, cls_target)
     box_target = encode_boxes(anchors, gt_boxes[best_gt])
-    return cls_target, box_target, fg
+    return cls_target, box_target, fg, best_gt, best_iou
 
 
 # ---------------------------------------------------------------------------
@@ -250,11 +253,39 @@ class HeadSubnet(nn.Module):
         return x.reshape(b, h * w * NUM_ANCHORS_PER_CELL, self.out_per_anchor)
 
 
+class ProtoNet(nn.Module):
+    """Prototype-mask generator (the YOLACT design, TPU-first): a conv
+    tower over P3 emitting ``num_prototypes`` full-scene mask bases at
+    stride 8 — instance masks are linear combinations of these, so the
+    per-instance work is one [N, K] coefficient head instead of any
+    RoIAlign/dynamic-shape crop (the reason two-stage mask heads don't
+    map to XLA; module docstring)."""
+
+    num_prototypes: int = 16
+    channels: int = 256
+    depth: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, p3: jnp.ndarray) -> jnp.ndarray:
+        x = p3
+        for i in range(self.depth):
+            x = nn.Conv(self.channels, (3, 3), dtype=self.dtype, name=f"conv{i}")(x)
+            x = nn.relu(x)
+        # f32 prototypes: they feed the mask BCE directly.
+        x = nn.Conv(self.num_prototypes, (1, 1), dtype=jnp.float32, name="proto")(x)
+        return nn.relu(x)  # [B, S/8, S/8, K]
+
+
 class RetinaNet(nn.Module):
     """Dense single-stage detector: backbone + FPN + shared heads.
 
     ``__call__`` returns (class_logits [B, N, K], box_deltas [B, N, 4]) with
-    N = total anchors over P3..P7 — fully static given image_size.
+    N = total anchors over P3..P7 — fully static given image_size.  With
+    ``with_masks`` it returns (cls, box, mask_coeffs [B, N, P],
+    prototypes [B, S/8, S/8, P]) — the instance-segmentation capability
+    of the reference's flagship (run.sh:86 MODE_MASK=True), in the
+    prototype-mask form that keeps every shape static.
     """
 
     num_classes: int = 80
@@ -262,6 +293,8 @@ class RetinaNet(nn.Module):
     fpn_channels: int = 256
     dtype: Any = jnp.float32
     freeze_backbone_norm: bool = False  # BACKBONE.NORM=FreezeBN analog
+    with_masks: bool = False
+    num_prototypes: int = 16
 
     @nn.compact
     def __call__(self, images: jnp.ndarray, train: bool = True):
@@ -283,7 +316,22 @@ class RetinaNet(nn.Module):
         )
         cls_out = jnp.concatenate([cls_head(p) for p in pyramid], axis=1)
         box_out = jnp.concatenate([box_head(p) for p in pyramid], axis=1)
-        return cls_out, box_out
+        if not self.with_masks:
+            return cls_out, box_out
+        coeff_head = HeadSubnet(
+            self.num_prototypes, self.fpn_channels, dtype=self.dtype,
+            name="coeff_head",
+        )
+        # tanh coefficients (YOLACT): bounded combinations keep the
+        # assembled mask logits in a trainable range.
+        coeff_out = jnp.tanh(
+            jnp.concatenate([coeff_head(p) for p in pyramid], axis=1)
+        ).astype(jnp.float32)
+        protos = ProtoNet(
+            self.num_prototypes, self.fpn_channels, dtype=self.dtype,
+            name="protonet",
+        )(pyramid[0])
+        return cls_out, box_out, coeff_out, protos
 
 
 def detection_loss(
@@ -301,7 +349,9 @@ def detection_loss(
     GSPMD the mean over the sharded batch makes the effective normalizer
     global, matching the single-program semantics.
     """
-    cls_t, box_t, fg = jax.vmap(partial(match_anchors, anchors))(gt_boxes, gt_classes)
+    cls_t, box_t, fg, _, _ = jax.vmap(partial(match_anchors, anchors))(
+        gt_boxes, gt_classes
+    )
     num_pos = jnp.maximum(jnp.sum(fg.astype(jnp.float32)), 1.0)
     cls_loss = jnp.sum(focal_loss(cls_logits, cls_t, num_classes)) / num_pos
     per_anchor_box = huber_loss(box_deltas.astype(jnp.float32), box_t)
@@ -312,6 +362,141 @@ def detection_loss(
         "box_loss": box_loss,
         "num_pos": num_pos,
     }
+
+
+def mask_loss(
+    protos: jnp.ndarray,       # [B, h, w, P] (stride-8 prototypes)
+    coeffs: jnp.ndarray,       # [B, N, P]
+    anchors: jnp.ndarray,      # [N, 4] (image pixels)
+    gt_boxes: jnp.ndarray,     # [B, M, 4] (image pixels, zero-padded)
+    gt_classes: jnp.ndarray,   # [B, M] (-1 = padding)
+    gt_masks: jnp.ndarray,     # [B, M, h, w] uint8/bool at prototype stride
+    max_pos: int = 32,
+    mask_stride: int = 8,
+) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
+    """Prototype-mask BCE on a FIXED budget of positive anchors — every
+    shape static (the TPU constraint two-stage mask heads violate).
+
+    Per image: the ``max_pos`` best-IoU foreground anchors are selected
+    with top_k (a fixed-size gather), their masks assembled as
+    ``sigmoid(protos @ coeff)``, and BCE is computed against the matched
+    instance's ground-truth mask, restricted to the ground-truth box
+    (the YOLACT crop) and normalized by box area.  Images with fewer
+    than ``max_pos`` positives contribute only their valid slots.
+    """
+    B, h, w, P = protos.shape
+
+    def one_image(protos_i, coeffs_i, gt_boxes_i, gt_classes_i, gt_masks_i):
+        _, _, fg, best_gt, best_iou = match_anchors(
+            anchors, gt_boxes_i, gt_classes_i
+        )
+        score = jnp.where(fg, best_iou, -1.0)
+        _, top = jax.lax.top_k(score, max_pos)       # [P_sel]
+        valid = score[top] > 0.0
+        coeff = coeffs_i[top]                         # [P_sel, P]
+        pred = jnp.einsum("hwk,pk->phw", protos_i, coeff)
+        gt_idx = best_gt[top]
+        target = gt_masks_i[gt_idx].astype(jnp.float32)   # [P_sel, h, w]
+        boxes = gt_boxes_i[gt_idx] / mask_stride
+        ys = jnp.arange(h, dtype=jnp.float32)[None, :, None]
+        xs = jnp.arange(w, dtype=jnp.float32)[None, None, :]
+        inside = (
+            (ys >= boxes[:, 0, None, None])
+            & (ys < boxes[:, 2, None, None])
+            & (xs >= boxes[:, 1, None, None])
+            & (xs < boxes[:, 3, None, None])
+        ).astype(jnp.float32)
+        bce = optax.sigmoid_binary_cross_entropy(pred, target) * inside
+        area = jnp.maximum(jnp.sum(inside, axis=(1, 2)), 1.0)
+        per_slot = jnp.sum(bce, axis=(1, 2)) / area
+        return jnp.sum(per_slot * valid.astype(jnp.float32)), jnp.sum(
+            valid.astype(jnp.float32)
+        )
+
+    totals, counts = jax.vmap(one_image)(
+        protos, coeffs, gt_boxes, gt_classes, gt_masks
+    )
+    n = jnp.maximum(jnp.sum(counts), 1.0)
+    loss = jnp.sum(totals) / n
+    return loss, {"mask_loss": loss, "mask_slots": n}
+
+
+def detection_loss_with_masks(
+    cls_logits, box_deltas, coeffs, protos, anchors,
+    gt_boxes, gt_classes, gt_masks, num_classes,
+    box_loss_weight: float = 50.0, mask_loss_weight: float = 6.125,
+    max_pos: int = 32, mask_stride: int = 8,
+):
+    """Box/class losses + prototype mask BCE — the MODE_MASK=True
+    training objective (run.sh:86), all static shapes."""
+    total, aux = detection_loss(
+        cls_logits, box_deltas, anchors, gt_boxes, gt_classes, num_classes,
+        box_loss_weight,
+    )
+    m_loss, m_aux = mask_loss(
+        protos, coeffs, anchors, gt_boxes, gt_classes, gt_masks,
+        max_pos=max_pos, mask_stride=mask_stride,
+    )
+    return total + mask_loss_weight * m_loss, {**aux, **m_aux}
+
+
+# ---------------------------------------------------------------------------
+# Pretrained-backbone transfer
+# ---------------------------------------------------------------------------
+
+
+def _intersect_copy(src: dict, dst: dict, copied: list) -> dict:
+    """Recursively copy leaves present in BOTH trees with matching shapes
+    (same pattern as bert.transfer_trunk_params, nested); mismatches and
+    src-only subtrees (the classifier's ``head``) are skipped."""
+    out = dict(dst)
+    for key, value in src.items():
+        if key not in out:
+            continue
+        if isinstance(value, dict) and isinstance(out[key], dict):
+            out[key] = _intersect_copy(value, out[key], copied)
+        elif getattr(value, "shape", None) == getattr(out[key], "shape", ()):
+            out[key] = jnp.asarray(value).astype(out[key].dtype)
+            copied.append(key)
+    return out
+
+
+def load_pretrained_backbone(
+    det_params: dict, det_model_state: dict, classifier_ckpt: dict
+) -> tuple[dict, dict, int]:
+    """ResNet classifier checkpoint -> the detector's ``backbone`` subtree.
+
+    The reference starts its flagship from an ImageNet-pretrained backbone
+    (run.sh:94 ``BACKBONE.WEIGHTS=ImageNet-R50-AlignPadding.npz``, staged
+    at prepare-s3-bucket.sh:33-36); here the classifier is this repo's own
+    ``resnet_imagenet`` checkpoint (a saved TrainState tree: params +
+    batch_stats).  Key-intersection transfer: every backbone conv/BN
+    parameter AND the BN running statistics; the classifier's ``head`` has
+    no counterpart and is dropped, the detector's FPN/heads keep their
+    fresh initialization.  Returns (params, model_state, n_copied).
+    """
+    src_params = classifier_ckpt.get("params", {})
+    copied: list = []
+    new_params = dict(det_params)
+    new_params["backbone"] = _intersect_copy(
+        src_params, det_params["backbone"], copied
+    )
+    new_state = dict(det_model_state)
+    src_stats = (classifier_ckpt.get("model_state") or {}).get("batch_stats", {})
+    if src_stats and "batch_stats" in det_model_state:
+        stats = dict(det_model_state["batch_stats"])
+        if "backbone" in stats:
+            stats["backbone"] = _intersect_copy(
+                src_stats, stats["backbone"], copied
+            )
+            new_state["batch_stats"] = stats
+    if not copied:
+        raise ValueError(
+            "no backbone parameters transferred — the checkpoint does not "
+            "look like a ResNet classifier TrainState (or the backbone "
+            "depths differ)"
+        )
+    return new_params, new_state, len(copied)
 
 
 # ---------------------------------------------------------------------------
@@ -360,11 +545,17 @@ def predict(
     max_detections: int = 100,
     score_threshold: float = 0.05,
     iou_threshold: float = 0.5,
+    coeffs: jnp.ndarray | None = None,
+    protos: jnp.ndarray | None = None,
+    mask_stride: int = 8,
 ):
     """Decode one image's head outputs into final detections.
 
     Class-agnostic NMS over the best class per anchor — static shapes
-    throughout; vmap over the batch for batched inference.
+    throughout; vmap over the batch for batched inference.  With
+    ``coeffs`` [N, P] + ``protos`` [h, w, P] the output additionally
+    carries ``masks`` [D, h, w] (sigmoid > 0.5, cropped to the detected
+    box — the YOLACT assembly at prototype stride).
     """
     probs = jax.nn.sigmoid(cls_logits.astype(jnp.float32))
     best_class = jnp.argmax(probs, axis=-1)
@@ -380,4 +571,18 @@ def predict(
     iou = box_iou(boxes, decoded)
     src = jnp.argmax(iou, axis=1)
     classes = best_class[src]
-    return {"boxes": boxes, "scores": scores, "classes": classes, "valid": valid}
+    out = {"boxes": boxes, "scores": scores, "classes": classes, "valid": valid}
+    if coeffs is not None and protos is not None:
+        h, w, _ = protos.shape
+        pred = jnp.einsum("hwk,dk->dhw", protos, coeffs[src])  # [D, h, w]
+        scaled = boxes / mask_stride
+        ys = jnp.arange(h, dtype=jnp.float32)[None, :, None]
+        xs = jnp.arange(w, dtype=jnp.float32)[None, None, :]
+        inside = (
+            (ys >= scaled[:, 0, None, None])
+            & (ys < scaled[:, 2, None, None])
+            & (xs >= scaled[:, 1, None, None])
+            & (xs < scaled[:, 3, None, None])
+        )
+        out["masks"] = (jax.nn.sigmoid(pred) > 0.5) & inside
+    return out

@@ -107,6 +107,19 @@ class Checkpointer:
         log.info("restored checkpoint step %d from %s", step, self.directory)
         return state, step
 
+    def restore_raw(self) -> tuple[Any, int] | None:
+        """Restore the newest checkpoint WITHOUT a shape/sharding template
+        — host numpy arrays in the saved tree structure.  The transfer
+        path (a classifier checkpoint feeding a detector backbone,
+        run.sh:94's BACKBONE.WEIGHTS analog) needs the source tree before
+        any target state exists."""
+        step = self._manager.latest_step()
+        if step is None:
+            return None
+        state = self._manager.restore(step)
+        log.info("restored raw checkpoint step %d from %s", step, self.directory)
+        return state, step
+
     def wait(self) -> None:
         """Block until async saves land (call before teardown)."""
         self._manager.wait_until_finished()

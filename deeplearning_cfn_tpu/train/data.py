@@ -205,6 +205,11 @@ class SyntheticDetectionDataset:
     # SyntheticDataset.template_seed): held-out splits share template_seed
     # with training but use a different seed.
     template_seed: int | None = None
+    # Instance masks at stride ``mask_stride`` (y["masks"]: [B, M, h, w]
+    # uint8, exact rectangle fills) — the training signal for the
+    # prototype-mask head (run.sh:86 MODE_MASK=True analog).
+    with_masks: bool = False
+    mask_stride: int = 8
 
     def batches(self, steps: int) -> Iterator[Batch]:
         rng = np.random.default_rng(self.seed)
@@ -217,12 +222,18 @@ class SyntheticDetectionDataset:
             0.5, 1.5, size=(self.num_classes, 3)
         ).astype(np.float32)
         s = self.image_size
+        ms = s // self.mask_stride
         for _ in range(steps):
             x = rng.normal(0.0, 0.05, size=(self.batch_size, s, s, 3)).astype(
                 np.float32
             )
             boxes = np.zeros((self.batch_size, self.max_boxes, 4), np.float32)
             classes = np.full((self.batch_size, self.max_boxes), -1, np.int32)
+            masks = (
+                np.zeros((self.batch_size, self.max_boxes, ms, ms), np.uint8)
+                if self.with_masks
+                else None
+            )
             for b in range(self.batch_size):
                 n = int(rng.integers(1, self.max_boxes + 1))
                 for i in range(n):
@@ -234,7 +245,15 @@ class SyntheticDetectionDataset:
                     x[b, y0 : y0 + h, x0 : x0 + w] += colors[c]
                     boxes[b, i] = (y0, x0, y0 + h, x0 + w)
                     classes[b, i] = c
-            yield Batch(x=x, y={"boxes": boxes, "classes": classes})
+                    if masks is not None:
+                        st = self.mask_stride
+                        masks[b, i,
+                              y0 // st : max(y0 // st + 1, (y0 + h) // st),
+                              x0 // st : max(x0 // st + 1, (x0 + w) // st)] = 1
+            y = {"boxes": boxes, "classes": classes}
+            if masks is not None:
+                y["masks"] = masks
+            yield Batch(x=x, y=y)
 
 
 def device_put_batch(batch: Batch, sharding) -> tuple[jax.Array, jax.Array]:

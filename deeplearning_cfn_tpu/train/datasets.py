@@ -446,6 +446,46 @@ def detection_spec(size: int, max_boxes: int) -> RecordSpec:
     )
 
 
+def instance_spec(size: int, max_boxes: int, mask_stride: int = 8) -> RecordSpec:
+    """Detection record + per-instance masks at ``mask_stride`` (the
+    prototype-mask training resolution, models/retinanet.py mask_loss) —
+    fixed shapes end to end: [max_boxes, size/stride, size/stride] uint8
+    bitmaps, zero where the instance slot is padding."""
+    ms = size // mask_stride
+    return RecordSpec(
+        (
+            Field("x", "uint8", (size, size, 3)),
+            Field("boxes", "float32", (max_boxes, 4)),
+            Field("classes", "int32", (max_boxes,)),
+            Field("masks", "uint8", (max_boxes, ms, ms)),
+        )
+    )
+
+
+def _rasterize_polygons(
+    segmentation, scale: float, size: int, mask_stride: int
+) -> np.ndarray | None:
+    """COCO polygon list -> uint8 bitmap at the prototype stride (PIL
+    polygon fill — the converter already depends on PIL).  None for RLE
+    segmentations (crowd regions, already skipped by the caller)."""
+    from PIL import Image, ImageDraw
+
+    if not isinstance(segmentation, list) or not segmentation:
+        return None
+    ms = size // mask_stride
+    im = Image.new("L", (ms, ms), 0)
+    draw = ImageDraw.Draw(im)
+    for poly in segmentation:
+        if len(poly) < 6:
+            continue
+        pts = [
+            (poly[i] * scale / mask_stride, poly[i + 1] * scale / mask_stride)
+            for i in range(0, len(poly) - 1, 2)
+        ]
+        draw.polygon(pts, fill=1)
+    return np.asarray(im, np.uint8)
+
+
 def _letterbox(img: np.ndarray, size: int) -> tuple[np.ndarray, float]:
     """Scale longest side to ``size``, pad bottom/right; returns (out, scale)."""
     from PIL import Image
@@ -466,12 +506,19 @@ def convert_coco(
     size: int = 512,
     max_boxes: int = 50,
     split: str = "train",
+    masks: bool = False,
+    mask_stride: int = 8,
 ) -> dict:
     """COCO ``instances_*.json`` + image dir -> ``<split>.dlc``.
 
     Category ids are remapped to a dense [0, n) contiguous range (COCO's
     published ids have holes); the mapping is written next to the records
     as ``categories.json``.
+
+    ``masks=True`` additionally rasterizes each instance's segmentation
+    polygons into a fixed [max_boxes, size/stride, size/stride] uint8
+    bitmap per record (:func:`instance_spec`) — the instance-mask signal
+    the reference's flagship trains on (run.sh:86 MODE_MASK=True).
     """
     from PIL import Image
 
@@ -484,12 +531,17 @@ def convert_coco(
         if a.get("iscrowd"):
             continue
         by_image.setdefault(a["image_id"], []).append(a)
-    spec = detection_spec(size, max_boxes)
+    spec = (
+        instance_spec(size, max_boxes, mask_stride)
+        if masks
+        else detection_spec(size, max_boxes)
+    )
 
     skipped = 0
 
     def gen():
         nonlocal skipped
+        ms = size // mask_stride
         for info in ann.get("images", []):
             path = images_dir / info["file_name"]
             if not path.exists():
@@ -500,12 +552,22 @@ def convert_coco(
             out, scale = _letterbox(img, size)
             boxes = np.zeros((max_boxes, 4), np.float32)
             classes = np.full((max_boxes,), -1, np.int32)
+            inst_masks = np.zeros((max_boxes, ms, ms), np.uint8) if masks else None
             anns = by_image.get(info["id"], [])[:max_boxes]
             for i, a in enumerate(anns):
                 x0, y0, w, h = a["bbox"]  # COCO xywh, original pixels
                 boxes[i] = (y0 * scale, x0 * scale, (y0 + h) * scale, (x0 + w) * scale)
                 classes[i] = cat_index[a["category_id"]]
-            yield spec.encode(x=out, boxes=boxes, classes=classes)
+                if inst_masks is not None:
+                    bitmap = _rasterize_polygons(
+                        a.get("segmentation"), scale, size, mask_stride
+                    )
+                    if bitmap is not None:
+                        inst_masks[i] = bitmap
+            fields = {"x": out, "boxes": boxes, "classes": classes}
+            if inst_masks is not None:
+                fields["masks"] = inst_masks
+            yield spec.encode(**fields)
 
     n = write_records(out_dir / f"{split}.dlc", spec, gen())
     (out_dir / "categories.json").write_text(
@@ -527,17 +589,22 @@ def detection_batches(
     loader, spec: RecordSpec, steps: int | None = None
 ) -> Iterator[Batch]:
     """Decode detection records from a NativeRecordLoader into the
-    trainer's ``Batch(x, y={"boxes", "classes"})`` shape, normalizing
-    images with ImageNet statistics."""
+    trainer's ``Batch(x, y={"boxes", "classes"[, "masks"]})`` shape,
+    normalizing images with ImageNet statistics.  Instance-mask records
+    (:func:`instance_spec`) pass their bitmaps through."""
+    has_masks = any(f.name == "masks" for f in spec.fields)
     i = 0
     while steps is None or i < steps:
         raw = loader.next_raw(copy=False)
         if raw is None:
             return
         arrays = spec.decode_batch(raw)
+        y = {"boxes": arrays["boxes"], "classes": arrays["classes"]}
+        if has_masks:
+            y["masks"] = arrays["masks"]
         yield Batch(
             x=normalize_images(arrays["x"], IMAGENET_MEAN, IMAGENET_STD),
-            y={"boxes": arrays["boxes"], "classes": arrays["classes"]},
+            y=y,
         )
         i += 1
 

@@ -37,6 +37,22 @@ def box_iou_np(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return (inter / np.maximum(union, 1e-9)).astype(np.float32)
 
 
+def mask_iou_np(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """IoU of [N, h, w] x [M, h, w] boolean instance masks — the matching
+    criterion of mask AP (the reference flagship's MODE_MASK metric
+    surface, run.sh:86)."""
+    if len(a) == 0 or len(b) == 0:
+        return np.zeros((len(a), len(b)), np.float32)
+    # Matmul form: intersection = af @ bf.T, union = |a| + |b| - inter —
+    # [N, M] intermediates only (the broadcast form allocates
+    # [N, M, h*w], ~10 MB per class-image pair at 512px records).
+    af = np.asarray(a, bool).reshape(len(a), -1).astype(np.float32)
+    bf = np.asarray(b, bool).reshape(len(b), -1).astype(np.float32)
+    inter = af @ bf.T
+    union = af.sum(-1)[:, None] + bf.sum(-1)[None, :] - inter
+    return (inter / np.maximum(union, 1e-9)).astype(np.float32)
+
+
 def average_precision(recall: np.ndarray, precision: np.ndarray) -> float:
     """All-points interpolated AP (PASCAL VOC 2010+ convention)."""
     r = np.concatenate([[0.0], recall, [1.0]])
@@ -57,6 +73,9 @@ class DetectionAccumulator:
 
     num_classes: int
     iou_threshold: float = 0.5
+    # "box" (default) matches on box IoU; "mask" on instance-bitmap IoU —
+    # the mask-AP criterion (requires pred_masks/gt_masks per image).
+    iou_kind: str = "box"
     # per class: list of (score, is_tp)
     _dets: dict[int, list[tuple[float, bool]]] = field(default_factory=dict)
     _gt_count: dict[int, int] = field(default_factory=dict)
@@ -70,26 +89,39 @@ class DetectionAccumulator:
         pred_valid: np.ndarray,    # [D] bool-ish
         gt_boxes: np.ndarray,      # [M, 4] (zero-padded)
         gt_classes: np.ndarray,    # [M] (-1 = padding)
+        pred_masks: np.ndarray | None = None,  # [D, h, w] (iou_kind=mask)
+        gt_masks: np.ndarray | None = None,    # [M, h, w] (iou_kind=mask)
     ) -> None:
+        if self.iou_kind == "mask" and (pred_masks is None or gt_masks is None):
+            raise ValueError("iou_kind='mask' needs pred_masks and gt_masks")
         self.images += 1
         keep = np.asarray(pred_valid).astype(bool)
         pred_boxes = np.asarray(pred_boxes)[keep]
         pred_scores = np.asarray(pred_scores)[keep]
         pred_classes = np.asarray(pred_classes)[keep]
+        if pred_masks is not None:
+            pred_masks = np.asarray(pred_masks)[keep]
         real = np.asarray(gt_classes) >= 0
         gt_boxes = np.asarray(gt_boxes)[real]
         gt_classes = np.asarray(gt_classes)[real]
+        if gt_masks is not None:
+            gt_masks = np.asarray(gt_masks)[real]
 
         for c in np.unique(np.concatenate([pred_classes, gt_classes])).tolist():
             c = int(c)
-            gt_c = gt_boxes[gt_classes == c]
+            cls_sel = gt_classes == c
+            gt_c = gt_boxes[cls_sel]
             self._gt_count[c] = self._gt_count.get(c, 0) + len(gt_c)
             det_mask = pred_classes == c
             det_boxes = pred_boxes[det_mask]
             det_scores = pred_scores[det_mask]
             order = np.argsort(-det_scores)
             det_boxes, det_scores = det_boxes[order], det_scores[order]
-            iou = box_iou_np(det_boxes, gt_c)
+            if self.iou_kind == "mask":
+                det_m = pred_masks[det_mask][order]
+                iou = mask_iou_np(det_m, gt_masks[cls_sel])
+            else:
+                iou = box_iou_np(det_boxes, gt_c)
             matched = np.zeros(len(gt_c), bool)
             bucket = self._dets.setdefault(c, [])
             for i in range(len(det_boxes)):

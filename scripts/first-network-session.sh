@@ -159,13 +159,15 @@ if has imagenet; then
   record convert_imagenet_train "$WORK/convert-imagenet-train.json"
 fi
 if has coco; then
+  # --masks: instance bitmaps go into the records too (the flagship is
+  # MODE_MASK=True, run.sh:86).
   $DLCFN convert --format coco --src "$SRC/coco/train" \
     --annotations "$SRC/coco/instances_val2017.json" \
-    --out "$WORK/data/coco" --size "$SIZE" --split train \
+    --out "$WORK/data/coco" --size "$SIZE" --split train --masks \
     > "$WORK/convert-coco-train.json"
   $DLCFN convert --format coco --src "$SRC/coco/val" \
     --annotations "$SRC/coco/instances_val2017.json" \
-    --out "$WORK/data/coco" --size "$SIZE" --split val \
+    --out "$WORK/data/coco" --size "$SIZE" --split val --masks \
     > "$WORK/convert-coco-val.json"
   record convert_coco_train "$WORK/convert-coco-train.json"
   record convert_coco_val "$WORK/convert-coco-val.json"
@@ -190,19 +192,6 @@ if has cifar; then
     > "$WORK/train-cifar.json"
   record cifar "$WORK/train-cifar.json"
 fi
-if has coco; then
-  $PY -m deeplearning_cfn_tpu.examples.detection_train \
-    --data_dir "$WORK/data/coco" --image_size "$SIZE" \
-    --steps "$DET_STEPS" --eval_steps 10 --max_boxes 50 \
-    --metrics_dir "$WORK/metrics" \
-    ${DLCFN_FNS_DET_BATCH:+--global_batch_size "$DLCFN_FNS_DET_BATCH"} \
-    ${DLCFN_FNS_DET_BACKBONE:+--backbone "$DLCFN_FNS_DET_BACKBONE"} \
-    > "$WORK/train-coco.out"
-  tail -n1 "$WORK/train-coco.out" | $PY -c \
-    'import json,sys,ast; json.dump(ast.literal_eval(sys.stdin.read()), sys.stdout)' \
-    > "$WORK/train-coco.json"
-  record coco "$WORK/train-coco.json"
-fi
 
 if has imagenet; then
   # The north star: ResNet-50 -> 76% top-1.  The exact recipe: stepped
@@ -220,11 +209,34 @@ if has imagenet; then
     --target_accuracy "$IN_TARGET" --steps "$IN_STEPS" \
     --eval_every "$EPOCH_STEPS" --eval_steps 64 \
     --metrics_dir "$WORK/metrics" \
+    --checkpoint_dir "$WORK/ckpt/imagenet" \
     > "$WORK/train-imagenet.out"
   tail -n1 "$WORK/train-imagenet.out" | $PY -c \
     'import json,sys,ast; json.dump(ast.literal_eval(sys.stdin.read()), sys.stdout)' \
     > "$WORK/train-imagenet.json"
   record imagenet "$WORK/train-imagenet.json"
+fi
+
+if has coco; then
+  # Pretrained-backbone transfer (run.sh:94 BACKBONE.WEIGHTS analog):
+  # when the imagenet stage trained a ResNet-50 classifier, the detector
+  # starts from its backbone instead of from scratch.
+  BACKBONE_ARGS=""
+  if [ -d "$WORK/ckpt/imagenet" ] && \
+     [ "${DLCFN_FNS_DET_BACKBONE:-resnet50}" = "resnet50" ]; then
+    BACKBONE_ARGS="--backbone_ckpt $WORK/ckpt/imagenet"
+  fi
+  $PY -m deeplearning_cfn_tpu.examples.detection_train \
+    --data_dir "$WORK/data/coco" --image_size "$SIZE" \
+    --steps "$DET_STEPS" --eval_steps 10 --max_boxes 50 --masks \
+    --metrics_dir "$WORK/metrics" $BACKBONE_ARGS \
+    ${DLCFN_FNS_DET_BATCH:+--global_batch_size "$DLCFN_FNS_DET_BATCH"} \
+    ${DLCFN_FNS_DET_BACKBONE:+--backbone "$DLCFN_FNS_DET_BACKBONE"} \
+    > "$WORK/train-coco.out"
+  tail -n1 "$WORK/train-coco.out" | $PY -c \
+    'import json,sys,ast; json.dump(ast.literal_eval(sys.stdin.read()), sys.stdout)' \
+    > "$WORK/train-coco.json"
+  record coco "$WORK/train-coco.json"
 fi
 
 note "done; summary:"

@@ -96,6 +96,11 @@ def write_coco_fixture(root, n_images=4, seed=0):
                     "image_id": i,
                     "category_id": int(rng.choice([1, 3, 7])),
                     "bbox": [x0, y0, bw, bh],
+                    # Rectangle polygon (clockwise), real-COCO layout —
+                    # the mask converter rasterizes these.
+                    "segmentation": [
+                        [x0, y0, x0 + bw, y0, x0 + bw, y0 + bh, x0, y0 + bh]
+                    ],
                     "iscrowd": 0,
                     "area": bw * bh,
                 }
@@ -515,3 +520,50 @@ def test_mlm_batches_mask_semantics(tmp_path):
     assert (b.x[masked] == 257).all()
     assert ((b.y[masked] >= 0) & (b.y[masked] <= 256)).all()
     loader.close()
+
+
+def test_coco_mask_conversion(tmp_path):
+    """--masks rasterizes each instance's polygons into the fixed-shape
+    bitmap field (instance_spec), aligned with the scaled boxes."""
+    img_dir, ann_path, images, annotations = write_coco_fixture(tmp_path)
+    out = datasets.convert_coco(
+        img_dir, ann_path, tmp_path / "dlc", size=64, max_boxes=5, masks=True
+    )
+    assert out["records"]["train"] == 4
+    spec = datasets.instance_spec(64, 5)
+    decoded = read_all(tmp_path / "dlc" / "train.dlc", spec)
+    assert decoded["masks"].shape == (4, 5, 8, 8)
+    # Every real instance's mask is non-empty and concentrated inside its
+    # (stride-scaled) box; padded slots stay all-zero.
+    for r in range(4):
+        for slot in range(5):
+            cls = decoded["classes"][r, slot]
+            mask = decoded["masks"][r, slot]
+            if cls < 0:
+                assert mask.sum() == 0
+                continue
+            y1, x1, y2, x2 = decoded["boxes"][r, slot] / 8.0
+            ys, xs = np.nonzero(mask)
+            if len(ys) == 0:
+                # Sub-stride instances can rasterize to nothing at 8px.
+                assert (y2 - y1) * (x2 - x1) < 2.0
+                continue
+            assert ys.min() >= np.floor(y1) and ys.max() <= np.ceil(y2)
+            assert xs.min() >= np.floor(x1) and xs.max() <= np.ceil(x2)
+
+
+def test_detection_batches_pass_masks_through(tmp_path):
+    from deeplearning_cfn_tpu.train.native_loader import NativeRecordLoader
+
+    img_dir, ann_path, *_ = write_coco_fixture(tmp_path)
+    datasets.convert_coco(
+        img_dir, ann_path, tmp_path / "dlc", size=64, max_boxes=5, masks=True
+    )
+    spec = datasets.instance_spec(64, 5)
+    with NativeRecordLoader(
+        [tmp_path / "dlc" / "train.dlc"], spec, batch_size=2, n_threads=1,
+        shuffle=False, loop=False, drop_remainder=False,
+    ) as loader:
+        batch = next(datasets.detection_batches(loader, spec))
+    assert batch.y["masks"].shape == (2, 5, 8, 8)
+    assert batch.y["masks"].dtype == np.uint8
