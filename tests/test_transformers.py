@@ -14,6 +14,46 @@ from deeplearning_cfn_tpu.train.data import SyntheticMLMDataset, SyntheticTokenD
 from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
 
 
+RING_VS_DENSE_SCRIPT = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["DLCFN_COMPILE_CACHE"] = "off"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+import dataclasses, json
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from deeplearning_cfn_tpu.models import llama
+from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
+from deeplearning_cfn_tpu.train.data import SyntheticTokenDataset
+from deeplearning_cfn_tpu.train.trainer import TrainerConfig
+
+def losses(use_ring):
+    cfg = llama.LlamaConfig.tiny(vocab_size=128, seq_len=64)
+    if use_ring:
+        cfg = dataclasses.replace(cfg, use_ring_attention=True)
+    mesh = build_mesh(MeshSpec(dp=2, fsdp=2, sp=2))
+    trainer = llama.make_trainer(
+        cfg, mesh,
+        TrainerConfig(strategy="fsdp", optimizer="adamw", learning_rate=3e-3),
+    )
+    ds = SyntheticTokenDataset(seq_len=64, vocab_size=128, batch_size=8)
+    sample = next(iter(ds.batches(1)))
+    state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
+    # prefetch=0: on a 1-core host every extra live thread competes with
+    # the 8 virtual devices' collective participants for the single
+    # core; a starved participant trips XLA's hard 40 s rendezvous
+    # deadline (rendezvous.cc) and the process aborts.
+    _, out = trainer.fit(state, ds.batches(6), steps=6, prefetch=0)
+    return out
+
+print(json.dumps({"dense": losses(False), "ring": losses(True)}))
+"""
+
+
 def _llama_losses(mesh_spec, steps=12, use_ring=False, seq_len=64):
     cfg = llama.LlamaConfig.tiny(vocab_size=128, seq_len=seq_len)
     if use_ring:
@@ -39,10 +79,34 @@ def test_llama_3d_sharding_and_convergence():
 
 
 def test_llama_ring_attention_matches_dense():
-    # Same seed, same data: sp ring attention must track dense numerics.
-    _, dense_losses = _llama_losses(MeshSpec(dp=2, fsdp=2, sp=2), steps=6)
-    _, ring_losses = _llama_losses(MeshSpec(dp=2, fsdp=2, sp=2), steps=6, use_ring=True)
-    np.testing.assert_allclose(dense_losses, ring_losses, rtol=2e-3)
+    """Same seed, same data: sp ring attention must track dense numerics.
+
+    Runs in a fresh subprocess with one retry: this is the suite's
+    heaviest concurrency point (cross-module collectives over 8 virtual
+    devices on a 1-core host), and XLA's CPU collectives enforce a hard
+    40 s rendezvous deadline (rendezvous.cc: 'Exiting to ensure a
+    consistent program state') — a starved participant thread aborts the
+    whole process.  Isolated in a child so an infra abort cannot take
+    down the pytest process (it reproducibly did at the tail of the
+    full-suite run, at both the r3 and r4 trees), and retried once
+    because the deadline is a scheduling race, not a numerics failure."""
+    import json
+    import subprocess
+    import sys
+
+    # The script is fully self-bootstrapping (platform/devices/cache set
+    # in its own header before jax loads), so the inherited env is fine.
+    for attempt in range(2):
+        proc = subprocess.run(
+            [sys.executable, "-c", RING_VS_DENSE_SCRIPT],
+            capture_output=True, text=True, timeout=420,
+        )
+        if proc.returncode == 0:
+            break
+        rendezvous_abort = "rendezvous" in proc.stderr.lower()
+        assert rendezvous_abort and attempt == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    np.testing.assert_allclose(out["dense"], out["ring"], rtol=2e-3)
 
 
 def test_llama_mesh_layout_equivalence():
