@@ -37,6 +37,12 @@ def main(argv: list[str] | None = None) -> dict:
     p.add_argument("--depth", type=int, choices=sorted(DEPTHS), default=50)
     p.add_argument("--image_size", type=int, default=224)
     p.add_argument("--bf16", action=argparse.BooleanOptionalAction, default=True)
+    p.add_argument("--norm", choices=["batch", "group"], default="batch",
+                   help="normalization layer: BatchNorm (default) or "
+                        "GroupNorm-32 (no running stats; measured ~3%% "
+                        "slower at the bench shape — BENCH_NOTES r4 — "
+                        "but the standard choice for small-per-device-"
+                        "batch fine-tuning)")
     p.add_argument("--eval_steps", type=int, default=0,
                    help="held-out eval batches after training (0 = skip; "
                         "reads --data_dir's val/test split when staged)")
@@ -52,7 +58,9 @@ def main(argv: list[str] | None = None) -> dict:
     batch = args.global_batch_size or 32 * len(jax.devices())
     lr = args.learning_rate or 0.1
     mesh = default_mesh(args.strategy)
-    model = DEPTHS[args.depth](dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+    model = DEPTHS[args.depth](
+        dtype=jnp.bfloat16 if args.bf16 else jnp.float32, norm=args.norm
+    )
     ds = SyntheticDataset.imagenet_like(batch_size=batch, image_size=args.image_size)
     from deeplearning_cfn_tpu.examples.common import (
         make_lr_schedule,

@@ -27,6 +27,30 @@ import jax.numpy as jnp
 ModuleDef = Any
 
 
+class GroupNorm32(nn.Module):
+    """GroupNorm-32 with the same construction surface the blocks use for
+    BatchNorm (name= / scale_init=); group count capped for thin feature
+    maps (tiny test backbones)."""
+
+    dtype: Any = jnp.float32
+    scale_init: Any = nn.initializers.ones
+
+    @nn.compact
+    def __call__(self, y: jnp.ndarray) -> jnp.ndarray:
+        import math
+
+        # gcd, not min: the group count must DIVIDE the channel count,
+        # and widths that aren't multiples of 32 exist (thin test
+        # backbones, non-standard num_filters).
+        return nn.GroupNorm(
+            num_groups=math.gcd(32, int(y.shape[-1])),
+            epsilon=1e-5,
+            dtype=self.dtype,
+            scale_init=self.scale_init,
+            name="gn",
+        )(y)
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: tuple[int, int]
@@ -61,24 +85,39 @@ class ResNet(nn.Module):
     # When True, skip the classifier and return the {C2..C5} stage feature
     # maps (stride 4..32) — the backbone interface detection FPNs consume.
     return_features: bool = False
+    # "batch" (default, the reference family's normalization) or "group"
+    # (GroupNorm-32): the round-3 trace put the ResNet-50 step at an HBM
+    # ceiling dominated by BN stats/grads reduces, and named "a different
+    # normalization" as an untried byte-reduction lever — this flag makes
+    # the lever measurable (BENCH_NOTES r4).  GroupNorm has no running
+    # stats (no model_state, no train/eval asymmetry) and normalizes per
+    # sample, trading BN's global-batch statistics for a reduce that
+    # needs no cross-batch traffic.
+    norm: str = "batch"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        norm = partial(
-            nn.BatchNorm,
-            use_running_average=not train,
-            momentum=0.9,
-            epsilon=1e-5,
-            # Outputs in the compute dtype; statistics/params stay f32
-            # (flax computes mean/var in >= f32 and param_dtype defaults
-            # to f32, so running stats cannot diverge).  f32 BN outputs
-            # doubled HBM traffic on every normalization: the round-3
-            # trace attributed ~39% of the ResNet-50 step to BN-side
-            # elementwise+reduce fusions moving f32 activations
-            # (docs/BENCH_NOTES.md).
-            dtype=self.dtype,
-        )
+        if self.norm == "group":
+            norm = partial(GroupNorm32, dtype=self.dtype)
+        elif self.norm != "batch":
+            # Silent fallback would train the WRONG experiment.
+            raise ValueError(f"unknown norm {self.norm!r}; expected batch|group")
+        else:
+            norm = partial(
+                nn.BatchNorm,
+                use_running_average=not train,
+                momentum=0.9,
+                epsilon=1e-5,
+                # Outputs in the compute dtype; statistics/params stay f32
+                # (flax computes mean/var in >= f32 and param_dtype defaults
+                # to f32, so running stats cannot diverge).  f32 BN outputs
+                # doubled HBM traffic on every normalization: the round-3
+                # trace attributed ~39% of the ResNet-50 step to BN-side
+                # elementwise+reduce fusions moving f32 activations
+                # (docs/BENCH_NOTES.md).
+                dtype=self.dtype,
+            )
         x = x.astype(self.dtype)
         x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)

@@ -325,3 +325,52 @@ def test_enable_compile_cache_config_and_off_switch(tmp_path, monkeypatch):
         # process don't write a cache rooted in this test's tmp_path.
         jax.config.update("jax_compilation_cache_dir", prior_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", prior_min)
+
+
+def test_resnet_group_norm_variant_trains():
+    """ResNet(norm="group"): no batch_stats collection (GroupNorm keeps
+    no running statistics), same parameter surface otherwise, and a
+    train step runs — the measured normalization lever of BENCH_NOTES r4
+    (kept as an option: the right normalization for
+    small-per-device-batch detection fine-tuning)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning_cfn_tpu.models.resnet import ResNet
+    from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
+    from deeplearning_cfn_tpu.train.data import SyntheticDataset
+    from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
+
+    mesh = build_mesh(MeshSpec(dp=8))
+    model = ResNet(
+        stage_sizes=(1, 1, 1, 1), num_filters=8, num_classes=4, norm="group"
+    )
+    trainer = Trainer(
+        model, mesh,
+        TrainerConfig(learning_rate=0.01, has_train_arg=True,
+                      matmul_precision="float32"),
+    )
+    ds = SyntheticDataset(shape=(32, 32, 3), num_classes=4, batch_size=16)
+    batches = list(ds.batches(2))
+    state = trainer.init(jax.random.key(0), jnp.asarray(batches[0].x))
+    assert state.model_state == {}  # no running stats
+    state, losses = trainer.fit(state, iter(batches), steps=2)
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_resnet_norm_validation_and_gcd_groups():
+    import jax
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from deeplearning_cfn_tpu.models.resnet import ResNet
+
+    with _pytest.raises(ValueError, match="unknown norm"):
+        ResNet(stage_sizes=(1,), num_filters=8, norm="grup").init(
+            jax.random.key(0), jnp.zeros((1, 16, 16, 3)), train=True
+        )
+    # Widths that are not multiples of 32 still group-normalize (gcd).
+    m = ResNet(stage_sizes=(1,), num_filters=12, num_classes=3, norm="group")
+    v = m.init(jax.random.key(0), jnp.zeros((1, 16, 16, 3)), train=True)
+    out = m.apply(v, jnp.ones((1, 16, 16, 3)), train=True)
+    assert out.shape == (1, 3)
