@@ -45,6 +45,13 @@ def main(argv: list[str] | None = None) -> dict:
                         "(time-to-accuracy mode, README.md:141)")
     p.add_argument("--eval_steps", type=int, default=0,
                    help="held-out eval batches after training (0 = skip)")
+    p.add_argument("--full_eval", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="when the eval split is genuinely held out, score "
+                        "the final claimed eval on the ENTIRE split "
+                        "(--eval_steps then only gates whether eval runs "
+                        "at all) — the reference's 92%% number is whole-"
+                        "dataset (README.md:141)")
     p.add_argument("--eval_data_dir", default=None,
                    help="record dir(s) for a genuinely held-out eval split; "
                         "unset with --data_dir = an unshuffled pass over the "
@@ -85,6 +92,10 @@ def main(argv: list[str] | None = None) -> dict:
             lr_schedule=make_lr_schedule(args, lr),
             has_train_arg=True,
             optimizer="momentum",
+            # Masked (rank>=2) L2 weight decay — the missing ingredient
+            # of the canonical recipes (VERDICT r4 missing #2); 0 keeps
+            # short benchmark runs comparable across rounds.
+            weight_decay=args.weight_decay or 0.0,
             # Sync/early-stop cadence follows the CLI flag (log_every=1 =>
             # per-step stop_fn, the time-to-accuracy mode).
             log_every=args.log_every,
@@ -154,12 +165,16 @@ def main(argv: list[str] | None = None) -> dict:
                 )
             return image_batches(eargs, (32, 32, 3), ds, eval_mode=True)
 
+        record_heldout = False  # full_eval applies only to record-backed
+        # single-pass splits — the synthetic fallback's stream has no
+        # "whole split" to exhaust.
         if args.eval_data_dir:
             # Operator-staged held-out records.
             eval_args = copy.copy(args)
             eval_args.data_dir = args.eval_data_dir
             eval_batches = eval_pipeline(eval_args)
             split = "heldout"
+            record_heldout = True
         elif args.data_dir:
             # eval_mode picks the test/val split when the converter staged
             # one (genuinely held out); otherwise it is an unshuffled pass
@@ -169,6 +184,7 @@ def main(argv: list[str] | None = None) -> dict:
 
             eval_batches = eval_pipeline(args)
             split = "heldout" if has_heldout_split(args.data_dir) else "train"
+            record_heldout = split == "heldout"
         else:
             # Synthetic: same task (template_seed matches the training
             # templates), disjoint sample stream.
@@ -178,12 +194,20 @@ def main(argv: list[str] | None = None) -> dict:
             )
             eval_batches = eval_ds.batches
             split = "heldout"
-        result["eval"] = {
-            "split": split,
-            **trainer.evaluate(
-                state, eval_batches(args.eval_steps), steps=args.eval_steps
-            ),
-        }
+        if args.full_eval and record_heldout:
+            # Whole-split pass (single-pass eval stream, tail batch
+            # included); the subsample size only decided THAT eval runs.
+            result["eval"] = {
+                "split": "heldout-full",
+                **trainer.evaluate(state, eval_batches(None)),
+            }
+        else:
+            result["eval"] = {
+                "split": split,
+                **trainer.evaluate(
+                    state, eval_batches(args.eval_steps), steps=args.eval_steps
+                ),
+            }
         if sink is not None:
             sink.write({"event": "eval", "run": args.model, **result["eval"]})
     if sink is not None:

@@ -173,6 +173,7 @@ def main(argv: list[str] | None = None) -> dict:
             learning_rate=lr,
             has_train_arg=True,
             optimizer=args.optimizer,
+            weight_decay=args.weight_decay or 0.0,
             grad_clip_norm=10.0,
             log_every=args.log_every,
         ),

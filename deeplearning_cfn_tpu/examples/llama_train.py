@@ -47,6 +47,10 @@ def main(argv: list[str] | None = None) -> dict:
     p = base_parser(__doc__)
     p.add_argument("--size", choices=["tiny", "435m", "1b", "8b"], default="tiny")
     p.add_argument("--seq_len", type=int, default=512)
+    p.add_argument("--optimizer", choices=["adamw", "adafactor"], default="adamw",
+                   help="adafactor = factored second moments, no first "
+                        "moment: the memory-lean rung that pushes the "
+                        "16 GiB-chip model ladder past adamw's ~1.1B cap")
     p.add_argument("--fsdp", type=int, default=None, help="fsdp axis size (default: all devices)")
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
@@ -103,13 +107,13 @@ def main(argv: list[str] | None = None) -> dict:
         mesh,
         TrainerConfig(
             strategy="fsdp",
-            optimizer="adamw",
+            optimizer=args.optimizer,
             learning_rate=lr,
             # --lr_schedule cosine = the standard LM recipe (linear
             # warmup + cosine decay); default stays constant so short
             # benchmark runs are comparable across rounds.
             lr_schedule=make_lr_schedule(args, lr),
-            weight_decay=0.1,
+            weight_decay=args.weight_decay if args.weight_decay is not None else 0.1,
             grad_clip_norm=1.0,
             log_every=args.log_every,
         ),

@@ -45,11 +45,23 @@ def main(argv: list[str] | None = None) -> dict:
                         "batch fine-tuning)")
     p.add_argument("--eval_steps", type=int, default=0,
                    help="held-out eval batches after training (0 = skip; "
-                        "reads --data_dir's val/test split when staged)")
+                        "reads --data_dir's val/test split when staged).  "
+                        "In --target_accuracy mode this sizes only the "
+                        "fast mid-run monitor; the gate itself confirms "
+                        "on the full split (--full_eval)")
     p.add_argument("--target_accuracy", type=float, default=None,
                    help="stop when held-out top-1 reaches this — the "
                         "north star's 76%% time-to-accuracy mode (eval "
                         "runs every --eval_every steps)")
+    p.add_argument("--full_eval", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="score the target gate (and the final claimed "
+                        "eval) on the ENTIRE staged val split — a 16k "
+                        "subsample has ~±0.3%% noise at the 76.0 "
+                        "boundary, and the reference's published numbers "
+                        "are whole-dataset (README.md:141).  The "
+                        "--eval_steps subsample remains the mid-run "
+                        "monitor; synthetic runs are unaffected")
     p.add_argument("--eval_every", type=int, default=0,
                    help="steps between held-out top-1 evals in "
                         "--target_accuracy mode (default: --steps/10)")
@@ -82,8 +94,12 @@ def main(argv: list[str] | None = None) -> dict:
             # The 76%-top-1 recipe: --lr_schedule step reproduces the
             # reference's stepped decay (run.sh:93); cosine is the
             # better modern default.  Constant LR cannot converge
-            # ResNet-50 (VERDICT r3 missing #3).
+            # ResNet-50 (VERDICT r3 missing #3), and neither does a
+            # decay-free run — the canonical 90-epoch recipe carries
+            # weight decay 1e-4 on kernels only (--weight_decay; norm
+            # scales/biases are mask-excluded).
             lr_schedule=make_lr_schedule(args, lr),
+            weight_decay=args.weight_decay or 0.0,
             has_train_arg=True,
             label_smoothing=0.1,
             log_every=args.log_every,
@@ -157,7 +173,23 @@ def main(argv: list[str] | None = None) -> dict:
                 state, eval_batches(eval_steps), steps=eval_steps
             )
             evals.append({"step": done, "split": split, **ev})
-            reached = float(ev.get("accuracy", 0.0)) >= args.target_accuracy
+            hit = float(ev.get("accuracy", 0.0)) >= args.target_accuracy
+            if hit and args.full_eval and split == "heldout":
+                # The subsample only MONITORS; the claim is scored on the
+                # whole split (the reference's published numbers are
+                # whole-dataset, README.md:141 — and at the 76.0 boundary
+                # a 16k subsample carries ~±0.3% sampling noise, enough
+                # to stop early below the real target).  steps=None
+                # consumes the single-pass eval stream to exhaustion,
+                # tail batch included (drop_remainder=False).
+                full_batches, _ = eval_source()
+                full = trainer.evaluate(state, full_batches(None))
+                evals.append({"step": done, "split": "heldout-full", **full})
+                reached = (
+                    float(full.get("accuracy", 0.0)) >= args.target_accuracy
+                )
+            else:
+                reached = hit
         result["eval_history"] = evals
         result["target_reached"] = reached
         result["eval"] = evals[-1]
@@ -168,12 +200,20 @@ def main(argv: list[str] | None = None) -> dict:
         )
         if args.eval_steps:
             eval_batches, split = eval_source()
-            result["eval"] = {
-                "split": split,
-                **trainer.evaluate(
-                    state, eval_batches(args.eval_steps), steps=args.eval_steps
-                ),
-            }
+            if args.full_eval and split == "heldout":
+                # The final claimed number covers the whole split.
+                result["eval"] = {
+                    "split": "heldout-full",
+                    **trainer.evaluate(state, eval_batches(None)),
+                }
+            else:
+                result["eval"] = {
+                    "split": split,
+                    **trainer.evaluate(
+                        state, eval_batches(args.eval_steps),
+                        steps=args.eval_steps,
+                    ),
+                }
     if ckpt is not None:
         ckpt.save(int(jax.device_get(state.step)), state)
         ckpt.close()

@@ -71,8 +71,15 @@ def stepped(
     """
     if not boundaries:
         raise ValueError("stepped schedule needs at least one boundary")
-    if sorted(boundaries) != list(boundaries):
-        raise ValueError(f"boundaries must be increasing, got {boundaries}")
+    if sorted(boundaries) != list(boundaries) or len(set(boundaries)) != len(
+        boundaries
+    ):
+        # Strictly increasing: a duplicated boundary would silently
+        # collapse in the {step: factor} dict and decay once where the
+        # recipe said twice.
+        raise ValueError(
+            f"boundaries must be strictly increasing, got {boundaries}"
+        )
     if warmup_steps <= 0:
         return optax.piecewise_constant_schedule(
             base_lr, {int(b): decay_factor for b in boundaries}
@@ -119,7 +126,15 @@ def build_schedule(
         warmup_steps = min(1000, max(0, total_steps // 20)) if kind == "cosine" else 0
     if kind == "cosine":
         return warmup_cosine(base_lr, total_steps, warmup_steps)
-    bounds = list(boundaries) if boundaries else default_step_boundaries(total_steps)
+    # Operator-passed duplicate boundaries raise in stepped() (a recipe
+    # listing a boundary twice means decay twice, which the dict form
+    # cannot express); the AUTO-derived fractions legitimately collide at
+    # smoke scale (50/75/90% of 2 steps -> [1,1,1]) and are deduped here.
+    bounds = (
+        list(boundaries)
+        if boundaries
+        else sorted(set(default_step_boundaries(total_steps)))
+    )
     # The builder clamps an over-long warmup into the run instead of
     # raising (stepped() itself stays strict): a production recipe sized
     # for the full run must also execute at smoke-test scale, where

@@ -71,7 +71,7 @@ class TrainerConfig:
     learning_rate: float = 0.01
     # Pass train=True/False to model.apply (models with dropout/BN need it).
     has_train_arg: bool = False
-    optimizer: str = "momentum"  # sgd | momentum | adamw | lamb
+    optimizer: str = "momentum"  # sgd | momentum | adamw | lamb | adafactor
     momentum: float = 0.9
     weight_decay: float = 0.0
     strategy: str = "dp"  # dp | fsdp
@@ -95,6 +95,17 @@ class TrainerConfig:
     log_every: int = 10
 
 
+def decay_mask(params: Any) -> Any:
+    """The canonical weight-decay mask: decay only rank>=2 tensors
+    (conv/dense kernels).  Norm scales and every bias are rank 1, so they
+    are excluded — decaying a BatchNorm scale toward zero fights the
+    normalization itself, and the standard 90-epoch ResNet-50 recipe (the
+    one the reference delegated to tensorpack/MXNet, run.sh:92-93)
+    excludes them.  Rank-based, not name-based: it holds for any Flax
+    module tree without pattern-matching parameter paths."""
+    return jax.tree_util.tree_map(lambda p: p.ndim > 1, params)
+
+
 def _make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
     lr = cfg.lr_schedule if cfg.lr_schedule is not None else cfg.learning_rate
     if cfg.optimizer == "sgd":
@@ -102,14 +113,44 @@ def _make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
     elif cfg.optimizer == "momentum":
         tx = optax.sgd(lr, momentum=cfg.momentum, nesterov=True)
     elif cfg.optimizer == "adamw":
-        tx = optax.adamw(lr, weight_decay=cfg.weight_decay)
+        tx = optax.adamw(lr, weight_decay=cfg.weight_decay, mask=decay_mask)
     elif cfg.optimizer == "lamb":
-        tx = optax.lamb(lr, weight_decay=cfg.weight_decay)
+        tx = optax.lamb(lr, weight_decay=cfg.weight_decay, mask=decay_mask)
+    elif cfg.optimizer == "adafactor":
+        # The memory-lean rung of the large-model ladder: factored second
+        # moments (O(rows+cols) per matrix instead of O(rows*cols)) and no
+        # first moment — the optimizer-state term that caps adamw at
+        # ~1.1B params on a 16 GiB chip nearly vanishes.
+        #
+        # Decay-semantics translation: optax.adafactor applies
+        # weight_decay_rate RAW per step (after LR scaling), while
+        # adamw/lamb apply lr * wd — a config value tuned for adamw
+        # (e.g. 0.1 at lr 3e-4) would decay ~1/lr-times stronger under
+        # adafactor and collapse the weights.  Map to the adamw-effective
+        # magnitude at the base LR so TrainerConfig.weight_decay means
+        # one thing across optimizers.  (With an LR schedule, adamw's
+        # effective decay tracks the schedule while this stays at the
+        # base-LR value — a documented, conservative approximation.)
+        tx = optax.adafactor(
+            lr,
+            weight_decay_rate=(
+                cfg.weight_decay * cfg.learning_rate
+                if cfg.weight_decay
+                else None
+            ),
+            weight_decay_mask=decay_mask,
+        )
     else:
         raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
     chain = []
     if cfg.grad_clip_norm:
         chain.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+    if cfg.weight_decay and cfg.optimizer in ("sgd", "momentum"):
+        # L2-into-momentum, the classic SGD form: the decay term joins the
+        # gradient BEFORE the momentum integrator and the lr scaling —
+        # exactly what "weight decay 1e-4" means in the canonical ResNet
+        # recipe.  adamw/lamb/adafactor carry decoupled decay internally.
+        chain.append(optax.add_decayed_weights(cfg.weight_decay, mask=decay_mask))
     chain.append(tx)
     return optax.chain(*chain) if len(chain) > 1 else tx
 
@@ -287,9 +328,14 @@ class Trainer:
         rep = replicated(self.mesh)
         return optax.tree_map_params(
             self.tx,
-            lambda _leaf, sh: sh,
+            # Shape guard: factored-optimizer leaves (adafactor's
+            # v_row/v_col, O(rows+cols) each) are param-ALIGNED but not
+            # param-SHAPED; forcing the param's sharding onto them would
+            # be ill-ranked.  They are small — replicate them.
+            lambda leaf, sh, p: sh if getattr(leaf, "shape", None) == p.shape else rep,
             opt_shape,
             param_sh,
+            abstract_params,
             transform_non_params=lambda _leaf: rep,
         )
 
@@ -391,6 +437,44 @@ class Trainer:
             self._eval_fn = self._build_eval_step()
         return self._eval_fn
 
+    def _batch_axis_shards(self) -> int:
+        """How many ways the leading (batch) axis is split on the mesh —
+        the divisibility requirement for any batch fed to the jitted
+        steps."""
+        spec = self.batch_sharding.spec
+        if not spec or spec[0] is None:
+            return 1
+        axes = spec[0] if isinstance(spec[0], tuple) else (spec[0],)
+        n = 1
+        for a in axes:
+            n *= self.mesh.shape[a]
+        return n
+
+    def _trim_to_shards(self, x, y):
+        """Full-split eval passes yield one final partial batch
+        (drop_remainder=False).  GSPMD requires the leading axis to
+        divide by the batch-shard count; when the tail doesn't, trim it
+        to the largest divisible size — LOUDLY, because the dropped
+        examples shrink the claimed split.  Returns (x, y, kept)."""
+        n = len(x)
+        div = self._batch_axis_shards()
+        if n % div == 0:
+            return x, y, n
+        keep = (n // div) * div
+        log.warning(
+            "eval tail batch of %d examples is not divisible by the %d "
+            "batch shards; dropping %d examples — size the eval batch to "
+            "divide the split for a complete pass", n, div, n - keep,
+        )
+        if keep == 0:
+            return None, None, 0
+        trim = lambda a: a[:keep]
+        return (
+            jax.tree_util.tree_map(trim, x),
+            jax.tree_util.tree_map(trim, y),
+            keep,
+        )
+
     def evaluate(
         self,
         state: TrainState,
@@ -410,6 +494,19 @@ class Trainer:
         # batch past the limit from the caller's iterator.
         if steps is not None:
             batches = itertools.islice(batches, steps)
+
+        def trimmed(src):
+            # Full-split passes (drop_remainder=False loaders) end with a
+            # partial batch; make it mesh-divisible BEFORE the prefetcher
+            # device_puts it.
+            from deeplearning_cfn_tpu.train.data import Batch
+
+            for b in src:
+                x, y, kept = self._trim_to_shards(b.x, b.y)
+                if kept:
+                    yield Batch(x=x, y=y)
+
+        batches = trimmed(batches)
         prefetcher: DevicePrefetcher | None = None
         if prefetch > 0:
             batches = prefetcher = DevicePrefetcher(

@@ -16,10 +16,15 @@
 //   - Sharding: (shard_index, shard_count) partitions the global index
 //     space round-robin, matching per-worker data sharding in an SPMD job.
 //   - Threading: N worker threads claim batch tickets from an atomic
-//     counter, pread their records into a pooled buffer, and push the
-//     finished batch to a bounded ready-queue (condition variables both
-//     directions).  Batches may complete out of order; training does not
-//     care about batch order within an epoch.
+//     counter, pread their records into a pooled buffer, and publish the
+//     finished batch into a bounded REORDER window keyed by ticket.  The
+//     consumer receives batches in exact ticket order regardless of
+//     thread scheduling: decode parallelism never changes the stream.
+//     That ordering is load-bearing twice over — (a) checkpoint resume
+//     (start_batch=step) is exact for any n_threads ("nothing replayed,
+//     nothing skipped", not a bounded approximation), and (b) multi-host
+//     SPMD training can run parallel decode while every host still sees
+//     the identical batch sequence.
 //
 // C ABI (ctypes-friendly), wrapped by deeplearning_cfn_tpu/train/native_loader.py.
 
@@ -54,6 +59,7 @@ struct RecordFile {
 struct Batch {
   std::vector<uint8_t> data;
   uint32_t n_records = 0;
+  uint64_t ticket = 0;
 };
 
 struct Loader {
@@ -77,8 +83,15 @@ struct Loader {
 
   std::mutex mu;
   std::condition_variable cv_ready;   // consumer waits: a batch is ready
-  std::condition_variable cv_space;   // producers wait: queue has space
-  std::deque<Batch> ready;
+  std::condition_variable cv_space;   // producers wait: window has space
+  // Reorder window: completed batches keyed by ticket, delivered to the
+  // consumer strictly in ticket order.  next_emit is the ticket the
+  // consumer receives next; workers may only publish tickets in
+  // [next_emit, next_emit + max_ready), which bounds both memory and the
+  // head-of-line wait.  The worker holding the lowest outstanding ticket
+  // always passes the gate, so the window cannot deadlock.
+  std::deque<Batch> ready;  // kept sorted by ticket (insertion sort)
+  uint64_t next_emit = 0;
   size_t max_ready = 4;
   uint64_t batches_emitted_this_epoch = 0;
   int live_threads = 0;  // workers still producing (guarded by mu)
@@ -191,13 +204,21 @@ void worker_main(Loader* L) {
       L->cv_space.notify_all();
       return;
     }
-    while (!L->stopping && L->ready.size() >= L->max_ready)
+    // Publish gate: only tickets inside the reorder window may land.
+    // (Window occupancy is bounded by the same condition — every queued
+    // ticket is >= next_emit and < next_emit + max_ready.)
+    while (!L->stopping && ticket >= L->next_emit + L->max_ready)
       L->cv_space.wait(lk);
     if (L->stopping) return;
     Batch b;
     b.data = std::move(buf);
     b.n_records = n;
-    L->ready.push_back(std::move(b));
+    b.ticket = ticket;
+    // Insertion sort from the back: windows are tiny (<= max_ready) and
+    // arrivals are nearly ordered, so this is effectively O(1).
+    auto it = L->ready.end();
+    while (it != L->ready.begin() && (it - 1)->ticket > ticket) --it;
+    L->ready.insert(it, std::move(b));
     L->batches_emitted_this_epoch++;
     if (L->batches_emitted_this_epoch == L->n_batches_per_epoch) {
       // epoch complete: advance permutation and release epoch+1 tickets
@@ -290,6 +311,7 @@ void* dlcfn_loader_open(const char** paths, int n_paths, int batch_size,
   // permutation is regenerated for THAT epoch (reshuffle is stateless in
   // everything but (seed, epoch)).
   L->next_ticket = start_batch;
+  L->next_emit = start_batch;
   L->epoch = start_batch / L->n_batches_per_epoch;
   L->batches_emitted_this_epoch = start_batch % L->n_batches_per_epoch;
   reshuffle(L);
@@ -320,9 +342,12 @@ int dlcfn_loader_next(void* h, uint8_t* out) {
   auto* L = (Loader*)h;
   std::unique_lock<std::mutex> lk(L->mu);
   for (;;) {
-    if (!L->ready.empty()) {
+    // In-order delivery: only the batch with ticket == next_emit may be
+    // handed out; later tickets wait in the window.
+    if (!L->ready.empty() && L->ready.front().ticket == L->next_emit) {
       Batch b = std::move(L->ready.front());
       L->ready.pop_front();
+      L->next_emit++;
       lk.unlock();
       memcpy(out, b.data.data(), b.data.size());
       lk.lock();
@@ -332,7 +357,7 @@ int dlcfn_loader_next(void* h, uint8_t* out) {
     if (!L->error.empty()) return -1;
     if (L->stopping) return 0;
     // Single-epoch mode: workers exit after the last epoch-0 ticket, so
-    // empty queue + no live producers means the data is exhausted.
+    // no pending next_emit batch + no live producers = data exhausted.
     if (L->live_threads == 0) return 0;
     L->cv_ready.wait(lk);
   }

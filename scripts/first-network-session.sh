@@ -182,7 +182,7 @@ if has cifar; then
   # LR with flip alone does not reliably converge to 92%.
   $PY -m deeplearning_cfn_tpu.examples.cifar10_train --model vgg11 \
     --data_dir "$WORK/data/cifar" --augment_flip --augment_crop \
-    --lr_schedule cosine --warmup_steps 500 \
+    --lr_schedule cosine --warmup_steps 500 --weight_decay 5e-4 \
     --target_accuracy "$TARGET" --steps "$STEPS" --eval_steps 20 \
     --metrics_dir "$WORK/metrics" \
     ${DLCFN_FNS_BATCH:+--global_batch_size "$DLCFN_FNS_BATCH"} \
@@ -196,15 +196,18 @@ fi
 if has imagenet; then
   # The north star: ResNet-50 -> 76% top-1.  The exact recipe: stepped
   # LR decay at 50/75/90% of the run (the run.sh:93 shape at the classic
-  # 30/60/80-of-90-epoch milestones), 5-epoch warmup, random-crop from
-  # margin records + flip, label smoothing 0.1 (in the example),
-  # batch 256 at base LR 0.1.  Held-out top-1 runs every ~epoch;
-  # training stops at the target.
+  # 30/60/80-of-90-epoch milestones), 5-epoch warmup, weight decay 1e-4
+  # on kernels only (norm scales/biases mask-excluded — the canonical
+  # recipe does not reach 76% without it), random-crop from margin
+  # records + flip, label smoothing 0.1 (in the example), batch 256 at
+  # base LR 0.1.  Held-out top-1 runs every ~epoch on a fast subsample;
+  # the TARGET GATE and the final claimed number eval the FULL val split.
   EPOCH_STEPS=$((1281167 / IN_BATCH))
   $PY -m deeplearning_cfn_tpu.examples.resnet_imagenet --depth 50 \
     --data_dir "$WORK/data/imagenet" --image_size "$IN_SIZE" \
     --augment_crop --augment_flip \
     --lr_schedule step --warmup_steps $((EPOCH_STEPS * 5)) \
+    --weight_decay 1e-4 \
     --learning_rate 0.1 --global_batch_size "$IN_BATCH" \
     --target_accuracy "$IN_TARGET" --steps "$IN_STEPS" \
     --eval_every "$EPOCH_STEPS" --eval_steps 64 \

@@ -77,6 +77,20 @@ def test_stepped_rejects_bad_boundaries():
         stepped(0.1, [])
     with pytest.raises(ValueError):
         stepped(0.1, [300, 200])
+    # Duplicates silently collapse in the {step: factor} dict — a recipe
+    # listing a boundary twice would decay ONCE with no error (ADVICE r4).
+    with pytest.raises(ValueError, match="strictly increasing"):
+        stepped(0.1, [200, 200, 300])
+    with pytest.raises(ValueError, match="strictly increasing"):
+        build_schedule("step", 0.1, 1000, boundaries=[500, 500])
+
+
+def test_build_schedule_dedupes_only_auto_boundaries():
+    """50/75/90% of a 2-step smoke run all land on step 1; the builder
+    dedupes its OWN derived boundaries instead of raising."""
+    s = build_schedule("step", 0.4, total_steps=2)
+    assert float(s(0)) == pytest.approx(0.4)
+    assert float(s(1)) == pytest.approx(0.04)  # one decay, not three
 
 
 def test_build_schedule_clamps_oversized_warmup():

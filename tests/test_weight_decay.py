@@ -1,0 +1,191 @@
+"""Masked weight decay + the memory-lean optimizer rung (VERDICT r4 #1/#4).
+
+The canonical vision recipes (92% CIFAR, README.md:141; the north star's
+76% ResNet-50) carry weight decay on KERNELS ONLY — decaying a norm scale
+fights the normalization itself.  The reference never owned this logic
+(it delegated recipes to tensorpack/MXNet, run.sh:92-93); here it is the
+trainer's, so it is pinned by tests: the rank>=2 mask must hold for every
+optimizer that decays, and adafactor must deliver the factored-state
+memory win that pushes the 16 GiB model ladder past adamw's ~1.1B cap.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deeplearning_cfn_tpu.train.trainer import (
+    Trainer,
+    TrainerConfig,
+    _make_optimizer,
+    decay_mask,
+)
+
+# A params tree shaped like a Flax conv+BN model: rank>=2 kernels decay,
+# rank-1 scales/biases never do.
+PARAMS = {
+    "Conv_0": {"kernel": jnp.ones((3, 3, 8, 16)), "bias": jnp.ones((16,))},
+    "BatchNorm_0": {"scale": jnp.ones((16,)), "bias": jnp.ones((16,))},
+    "Dense_0": {"kernel": jnp.ones((16, 10)), "bias": jnp.ones((10,))},
+}
+
+
+def _apply_zero_grads(tx, params):
+    """One update with zero grads: any parameter motion is pure decay."""
+    state = tx.init(params)
+    grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+    updates, _ = tx.update(grads, state, params)
+    return optax.apply_updates(params, updates)
+
+
+def test_decay_mask_is_rank_based():
+    mask = decay_mask(PARAMS)
+    assert mask["Conv_0"]["kernel"] is True
+    assert mask["Dense_0"]["kernel"] is True
+    assert mask["Conv_0"]["bias"] is False
+    assert mask["BatchNorm_0"]["scale"] is False
+    assert mask["BatchNorm_0"]["bias"] is False
+
+
+@pytest.mark.parametrize("opt", ["momentum", "sgd", "adamw", "lamb", "adafactor"])
+def test_weight_decay_excludes_norm_params_and_biases(opt):
+    """Under zero gradients, kernels shrink and rank-1 params stay put —
+    for EVERY optimizer that consumes TrainerConfig.weight_decay."""
+    tx = _make_optimizer(
+        TrainerConfig(optimizer=opt, weight_decay=0.1, learning_rate=0.1)
+    )
+    new = _apply_zero_grads(tx, PARAMS)
+    assert float(new["Conv_0"]["kernel"][0, 0, 0, 0]) < 1.0
+    assert float(new["Dense_0"]["kernel"][0, 0]) < 1.0
+    np.testing.assert_array_equal(np.asarray(new["BatchNorm_0"]["scale"]), 1.0)
+    np.testing.assert_array_equal(np.asarray(new["BatchNorm_0"]["bias"]), 1.0)
+    np.testing.assert_array_equal(np.asarray(new["Conv_0"]["bias"]), 1.0)
+
+
+def test_momentum_without_decay_is_unchanged():
+    """weight_decay=0.0 keeps the plain Nesterov path byte-identical
+    (benchmark comparability across rounds)."""
+    tx = _make_optimizer(TrainerConfig(optimizer="momentum", learning_rate=0.1))
+    new = _apply_zero_grads(tx, PARAMS)
+    np.testing.assert_array_equal(
+        np.asarray(new["Conv_0"]["kernel"]), np.asarray(PARAMS["Conv_0"]["kernel"])
+    )
+
+
+def test_momentum_decay_is_l2_into_momentum():
+    """The decay term rides the momentum integrator and the LR scaling —
+    classic L2-SGD: with Nesterov, the first zero-grad step moves a
+    kernel by (1+momentum) * lr * wd * w."""
+    lr, wd, mom = 0.5, 0.1, 0.9
+    tx = _make_optimizer(
+        TrainerConfig(optimizer="momentum", weight_decay=wd, learning_rate=lr,
+                      momentum=mom)
+    )
+    new = _apply_zero_grads(tx, PARAMS)
+    expected = 1.0 - (1.0 + mom) * lr * wd
+    assert float(new["Dense_0"]["kernel"][0, 0]) == pytest.approx(expected)
+
+
+def test_grad_clip_does_not_clip_the_decay_term():
+    """Clipping applies to gradients only; decay joins after.  A huge
+    decay with clip_norm=tiny must still move the kernel by the full
+    decay step."""
+    tx = _make_optimizer(
+        TrainerConfig(optimizer="sgd", weight_decay=0.1, learning_rate=1.0,
+                      grad_clip_norm=1e-8)
+    )
+    new = _apply_zero_grads(tx, PARAMS)
+    assert float(new["Dense_0"]["kernel"][0, 0]) == pytest.approx(0.9)
+
+
+def test_adafactor_decay_magnitude_matches_adamw_semantics():
+    """optax.adafactor applies weight_decay_rate RAW per step (post-LR)
+    where adamw applies lr*wd; the trainer translates so the SAME config
+    value produces the SAME effective first-step decay on a unit weight.
+    Without the translation, llama_train's adamw-tuned default (wd=0.1
+    at lr=3e-4) would shrink every kernel ~10% per step under adafactor
+    and the model would never train."""
+    lr, wd = 3e-4, 0.1
+    adamw = _make_optimizer(
+        TrainerConfig(optimizer="adamw", weight_decay=wd, learning_rate=lr)
+    )
+    ada = _make_optimizer(
+        TrainerConfig(optimizer="adafactor", weight_decay=wd, learning_rate=lr)
+    )
+    new_adamw = _apply_zero_grads(adamw, PARAMS)
+    new_ada = _apply_zero_grads(ada, PARAMS)
+    d_adamw = 1.0 - float(new_adamw["Dense_0"]["kernel"][0, 0])
+    d_ada = 1.0 - float(new_ada["Dense_0"]["kernel"][0, 0])
+    assert d_adamw == pytest.approx(lr * wd, rel=1e-3)
+    assert d_ada == pytest.approx(d_adamw, rel=1e-3)
+
+
+# --- adafactor: the memory-lean rung --------------------------------------
+
+def _state_bytes(state) -> int:
+    return sum(
+        a.size * a.dtype.itemsize
+        for a in jax.tree_util.tree_leaves(state)
+        if hasattr(a, "size")
+    )
+
+
+def test_adafactor_state_is_factored_and_lean():
+    """For a large matrix the optimizer state must be O(rows+cols), not
+    O(rows*cols): the property that lifts the 16 GiB-chip ladder past
+    adamw's ~1.1B cap (adamw charges 2x f32 param bytes)."""
+    params = {"w": jnp.zeros((1024, 2048)), "b": jnp.zeros((2048,))}
+    ada = _make_optimizer(
+        TrainerConfig(optimizer="adafactor", learning_rate=1e-2)
+    ).init(params)
+    adam = _make_optimizer(
+        TrainerConfig(optimizer="adamw", learning_rate=1e-2)
+    ).init(params)
+    param_bytes = _state_bytes(params)
+    assert _state_bytes(adam) >= 2 * param_bytes  # the cap being escaped
+    assert _state_bytes(ada) < 0.1 * param_bytes  # the escape
+
+
+def test_adafactor_trains_under_fsdp():
+    """Full trainer path on the 8-way mesh: factored state leaves (v_row/
+    v_col are param-aligned but not param-shaped) must survive the
+    opt-state sharding mapping, and the loss must move."""
+    import flax.linen as nn
+
+    from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(16)(nn.relu(nn.Dense(256)(x)))
+
+    mesh = build_mesh(MeshSpec(fsdp=8))
+    trainer = Trainer(
+        MLP(), mesh,
+        TrainerConfig(optimizer="adafactor", strategy="fsdp",
+                      learning_rate=3e-2, weight_decay=1e-4,
+                      matmul_precision="float32"),
+    )
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((32, 64), dtype=np.float32))
+    y = jnp.asarray(rng.integers(0, 16, size=(32,)), jnp.int32)
+    state = trainer.init(jax.random.key(0), x)
+    first = None
+    for _ in range(20):
+        state, metrics = trainer.train_step(state, x, y)
+        if first is None:
+            first = float(metrics["loss"])
+    assert float(metrics["loss"]) < first
+
+
+def test_llama_train_exposes_adafactor():
+    """--optimizer adafactor reaches the flagship example's trainer."""
+    from deeplearning_cfn_tpu.examples import llama_train
+
+    out = llama_train.main(
+        ["--size", "tiny", "--steps", "2", "--seq_len", "32",
+         "--global_batch_size", "8", "--optimizer", "adafactor",
+         "--log_every", "1"]
+    )
+    assert np.isfinite(out["final_loss"])
