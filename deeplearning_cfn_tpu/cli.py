@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -91,6 +92,15 @@ def _resolve_broker(spec: ClusterSpec, args) -> str | None:
     except (BrokerError, OSError) as e:
         # OSError: e.g. no write access to $DLCFN_ROOT for the record.
         raise SystemExit(f"broker provisioning failed: {e}") from e
+    # Publish the broker's AUTH token ambiently: every BrokerConnection
+    # this process opens (rendezvous backend, status) authenticates via
+    # $DLCFN_BROKER_TOKEN, and _backend_for stamps it into VM metadata.
+    # Operator-managed brokers (--broker HOST:PORT) export it themselves.
+    from deeplearning_cfn_tpu.cluster.broker_service import broker_token
+
+    token = broker_token(spec.name)
+    if token:
+        os.environ["DLCFN_BROKER_TOKEN"] = token
     print(
         f"broker for {spec.name!r}: {host}:{port} "
         f"({'started' if started else 'reused'})",
@@ -185,9 +195,12 @@ def _backend_for(spec: ClusterSpec, broker: str | None = None, recorder=None):
             spot=spec.pool.spot,
             startup_script=render_startup_script(spec),
             # Stamped into VM metadata (dlcfn-broker) so the startup
-            # script can hand agents their control plane.
+            # script can hand agents their control plane; the AUTH token
+            # rides the same channel (dlcfn-broker-token), the metadata
+            # analog of the reference's IAM-scoped credentials.
             broker_host=broker_addr[0] if broker_addr else None,
             broker_port=broker_addr[1] if broker_addr else 8477,
+            broker_token=os.environ.get("DLCFN_BROKER_TOKEN") or None,
             storage_namespace=spec.name,
             **extra,
         )
@@ -594,6 +607,7 @@ def cmd_convert(args) -> int:
                 max_boxes=args.max_boxes,
                 split=args.split,
                 masks=args.masks_coco,
+                mask_stride=args.mask_stride,
             )
         else:
             out = datasets.CONVERTERS[args.format](args.src, args.out)
@@ -779,6 +793,12 @@ def main(argv: list[str] | None = None) -> int:
     pc.add_argument("--annotations", default=None,
                     help="COCO instances_*.json path")
     pc.add_argument("--max-boxes", type=int, default=50, dest="max_boxes")
+    pc.add_argument("--mask-stride", type=int, default=8, dest="mask_stride",
+                    help="instance-mask raster stride for --format coco "
+                         "--masks: 8 (the prototype training resolution) "
+                         "for train splits; use a finer stride (1 or 2) "
+                         "for VAL splits so the image-resolution mask mAP "
+                         "scores against high-fidelity ground truth")
     pc.add_argument("--masks", action="store_true", dest="masks_coco",
                     help="coco: also rasterize instance-mask bitmaps into "
                          "the records (for detection_train --masks)")

@@ -12,6 +12,9 @@ worker VM with the cluster identity in env:
                          slice 0 is the default coordinator, and the
                          readiness ack carries the slice's group name so
                          per-slice indices stay globally unique
+  DLCFN_BROKER_TOKEN     shared-secret for the broker AUTH handshake
+                         (stamped into VM metadata at provision; consumed
+                         ambiently by every BrokerConnection)
   DLCFN_BROKER           host:port of the rendezvous broker (required —
                          without it the agent has no control plane)
   DLCFN_GROUPS           comma-separated worker-group names

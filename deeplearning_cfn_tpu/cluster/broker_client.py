@@ -33,12 +33,37 @@ class BrokerError(RuntimeError):
 
 
 class BrokerConnection:
-    """One TCP connection speaking the broker line protocol."""
+    """One TCP connection speaking the broker line protocol.
 
-    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+    ``token``: shared-secret for the AUTH handshake (the IAM-gating
+    analog of the reference's SQS control plane,
+    deeplearning.template:193-197).  Defaults to $DLCFN_BROKER_TOKEN —
+    the ambient channel the cluster contract stamps on VMs — so every
+    existing construction site authenticates without plumbing changes.
+    Pass an explicit token to override (controller-side callers read it
+    from the broker record)."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout_s: float = 10.0,
+        token: str | None = None,
+    ):
+        import os
+
         self.sock = socket.create_connection((host, port), timeout=timeout_s)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._buf = b""
+        if token is None:
+            token = os.environ.get("DLCFN_BROKER_TOKEN") or None
+        if token:
+            if any(c.isspace() for c in token):
+                raise BrokerError("broker token must not contain whitespace")
+            self.sock.sendall(f"AUTH {token}\n".encode())
+            resp = self._read_line()
+            if resp != "OK":
+                raise BrokerError(f"broker AUTH rejected: {resp}")
 
     def close(self) -> None:
         try:
@@ -128,9 +153,15 @@ class BrokerConnection:
 class BrokerQueue(RendezvousQueue):
     """RendezvousQueue over the native broker."""
 
-    def __init__(self, name: str, host: str = "127.0.0.1", port: int = 8477):
+    def __init__(
+        self,
+        name: str,
+        host: str = "127.0.0.1",
+        port: int = 8477,
+        token: str | None = None,
+    ):
         self.name = name
-        self._conn = BrokerConnection(host, port)
+        self._conn = BrokerConnection(host, port, token=token)
 
     def send(self, body: dict[str, Any]) -> str:
         return self._conn.send(self.name, json.dumps(body).encode())
@@ -175,15 +206,26 @@ def build_broker(force: bool = False) -> Path:
 
 
 class BrokerProcess:
-    """Build + spawn + supervise a local broker (ephemeral port by default)."""
+    """Build + spawn + supervise a local broker (ephemeral port by default).
 
-    def __init__(self, port: int = 0):
+    ``token``: spawn the broker with AUTH required (via env, never argv —
+    /proc cmdline is world-readable)."""
+
+    def __init__(self, port: int = 0, token: str | None = None):
+        import os
+
         build_broker()
+        self.token = token
+        env = dict(os.environ)
+        env.pop("DLCFN_BROKER_TOKEN", None)
+        if token:
+            env["DLCFN_BROKER_TOKEN"] = token
         self.proc = subprocess.Popen(
             [str(BROKER_BIN), str(port)],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
+            env=env,
         )
         assert self.proc.stdout is not None
         line = self.proc.stdout.readline()
@@ -201,7 +243,7 @@ class BrokerProcess:
         raise BrokerError("broker did not become reachable")
 
     def queue(self, name: str) -> BrokerQueue:
-        return BrokerQueue(name, "127.0.0.1", self.port)
+        return BrokerQueue(name, "127.0.0.1", self.port, token=self.token)
 
     def stop(self) -> None:
         self.proc.terminate()

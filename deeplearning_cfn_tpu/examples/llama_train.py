@@ -45,7 +45,7 @@ def main(argv: list[str] | None = None) -> dict:
 
     t_main = first_step_clock()
     p = base_parser(__doc__)
-    p.add_argument("--size", choices=["tiny", "435m", "1b", "8b"], default="tiny")
+    p.add_argument("--size", choices=["tiny", "435m", "1b", "3b", "8b"], default="tiny")
     p.add_argument("--seq_len", type=int, default=512)
     p.add_argument("--optimizer", choices=["adamw", "adafactor"], default="adamw",
                    help="adafactor = factored second moments, no first "
@@ -77,6 +77,10 @@ def main(argv: list[str] | None = None) -> dict:
 
     if args.size == "8b":
         cfg = llama.LlamaConfig.llama3_8b()
+    elif args.size == "3b":
+        # The adafactor rung: pass --optimizer adafactor — adamw's moment
+        # state cannot hold this on a 16 GiB chip (llama_memory).
+        cfg = llama.LlamaConfig.b3(seq_len=args.seq_len)
     elif args.size == "1b":
         cfg = llama.LlamaConfig.b1(seq_len=args.seq_len)
     elif args.size == "435m":

@@ -170,6 +170,28 @@ class LlamaConfig:
         )
 
     @classmethod
+    def b3(cls, seq_len: int = 1024) -> "LlamaConfig":
+        """~2.9B — the adafactor rung of the on-hardware ladder.  adamw
+        cannot hold this on a 16 GiB chip (params+grads+bf16 moments =
+        ~23.5 GB); with adafactor's factored state the per-param charge
+        drops to params+grads (~11.8 GB), leaving room for full-remat
+        activations at batch 4 x 1024 (llama_memory predicts ~13.2
+        GiB/chip).  Same conventions as b1: head_dim 128, flash
+        attention, tied embeddings, full remat."""
+        return cls(
+            vocab_size=32000,
+            dim=2560,
+            n_layers=36,
+            n_heads=20,
+            n_kv_heads=20,
+            mlp_dim=6912,
+            max_seq_len=seq_len,
+            tied_embeddings=True,
+            use_flash_attention=True,
+            remat_policy="full",
+        )
+
+    @classmethod
     def tiny(cls, vocab_size: int = 256, seq_len: int = 128, **kw) -> "LlamaConfig":
         return cls(
             vocab_size=vocab_size,

@@ -64,6 +64,23 @@ def _tree_bytes(shapes: Any, specs: Any, mesh_axes: dict[str, int]) -> int:
     return total
 
 
+def _adafactor_state_bytes(shapes: Any) -> int:
+    """Per-chip bytes of adafactor's state: factored f32 second moments
+    (v_row [.., d1] + v_col [.., d2] per rank>=2 tensor — O(rows+cols),
+    the term that makes the optimizer the memory-lean rung of the model
+    ladder), full f32 v for rank<2 leaves, no first moment.  Factored
+    leaves are replicated in the trainer's opt-state sharding (they are
+    tiny), so no shard division applies."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(shapes):
+        n = int(np.prod(leaf.shape))
+        if leaf.ndim >= 2:
+            total += 4 * (n // leaf.shape[-1] + n // leaf.shape[-2])
+        else:
+            total += 4 * n
+    return total
+
+
 @dataclass
 class MemoryReport:
     cfg_name: str
@@ -110,8 +127,11 @@ def memory_report(
     shapes = jax.eval_shape(partial(llama.init_params, cfg), jax.random.key(0))
     specs = llama.param_specs(cfg)
     params_b = _tree_bytes(shapes, specs, mesh_axes)
-    n_moments = {"adamw": 2, "lamb": 2, "momentum": 1, "sgd": 0}[optimizer]
-    optimizer_b = n_moments * params_b
+    if optimizer == "adafactor":
+        optimizer_b = _adafactor_state_bytes(shapes)
+    else:
+        n_moments = {"adamw": 2, "lamb": 2, "momentum": 1, "sgd": 0}[optimizer]
+        optimizer_b = n_moments * params_b
     gradients_b = params_b
 
     batch_shards = mesh_axes.get("dp", 1) * mesh_axes.get("fsdp", 1)
@@ -204,6 +224,7 @@ def validate_on_device(
     seq_len: int,
     steps: int = 3,
     cfg_name: str = "llama",
+    optimizer: str = "adamw",
 ) -> dict:
     """Hardware validation of the analytic model (round-3 verdict weak
     #3: 'an analytic model that has never met hardware is not feasibility
@@ -225,7 +246,7 @@ def validate_on_device(
     trainer = llama.make_trainer(
         cfg,
         mesh,
-        TrainerConfig(strategy="fsdp", optimizer="adamw", learning_rate=1e-4),
+        TrainerConfig(strategy="fsdp", optimizer=optimizer, learning_rate=1e-4),
     )
     rng = np.random.default_rng(0)
     tok = jnp.asarray(
@@ -245,6 +266,7 @@ def validate_on_device(
         {"fsdp": n},
         batch_global=batch_global,
         seq_len=seq_len,
+        optimizer=optimizer,
         cfg_name=cfg_name,
     )
     gib = 1024**3

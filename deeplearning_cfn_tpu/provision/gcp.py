@@ -80,6 +80,10 @@ class GCPBackend(Backend):
     runtime_version: str = "tpu-ubuntu2204-base"
     broker_host: str | None = None  # coordinator VM running dlcfn-broker
     broker_port: int = 8477
+    # Shared-secret for the broker's AUTH handshake; stamped into VM
+    # metadata (the reference's IAM-gated control plane analog,
+    # deeplearning.template:193-197).
+    broker_token: str | None = None
     clock: Clock = field(default_factory=MonotonicClock)
     # Networking (SURVEY C10): None network/subnetwork = the default network
     # (create path); explicit names = bring-your-own private subnet.
@@ -174,7 +178,8 @@ class GCPBackend(Backend):
         if name not in self._queues:
             if self.broker_host:
                 self._queues[name] = BrokerQueue(
-                    name, host=self.broker_host, port=self.broker_port
+                    name, host=self.broker_host, port=self.broker_port,
+                    token=self.broker_token,
                 )
             else:
                 # Control logic co-located with the provisioner (single
@@ -243,6 +248,14 @@ class GCPBackend(Backend):
                                             )
                                         }
                                         if self.broker_host
+                                        else {}
+                                    ),
+                                    # AUTH shared secret; without it a VM
+                                    # can reach but not speak to the
+                                    # rendezvous plane.
+                                    **(
+                                        {"dlcfn-broker-token": self.broker_token}
+                                        if self.broker_host and self.broker_token
                                         else {}
                                     ),
                                 },

@@ -144,7 +144,10 @@ def random_crop_batches(
             padded[:, pad : pad + h, pad : pad + w] = x
             x, h, w = padded, h + 2 * pad, w + 2 * pad
         if (h, w) == (th, tw):
-            yield b
+            # pad=0 degenerate passthrough still honors the "freshly
+            # allocated output" contract: downstream flips work in place
+            # and must never reach the source's buffer.
+            yield Batch(x=x.copy(), y=b.y)
             continue
         if h < th or w < tw:
             raise ValueError(f"cannot crop {h}x{w} records to {th}x{tw}")
@@ -167,7 +170,9 @@ def center_crop_batches(
         x = b.x
         _, h, w, _ = x.shape
         if (h, w) == (th, tw):
-            yield b
+            # Same fresh-allocation contract as random_crop_batches'
+            # passthrough: callers treat crop outputs as in-place-safe.
+            yield Batch(x=x.copy(), y=b.y)
             continue
         if h < th or w < tw:
             raise ValueError(f"cannot crop {h}x{w} records to {th}x{tw}")

@@ -29,6 +29,17 @@
 //                                            + group-state snapshots — the
 //                                            WaitCondition/describe analogs
 //                                            agents read on real VMs)
+//   AUTH <token>\n                        -> OK\n | ERR bad token\n (close)
+//
+// Authentication: when the DLCFN_BROKER_TOKEN environment variable is set
+// at spawn, every verb except PING requires a successful AUTH first on the
+// connection — the shared-secret analog of the IAM gating on the
+// reference's SQS control plane (deeplearning.template:193-197).  The
+// advertise interface is exactly what every VPC host can reach; without
+// the token any of them could register phantom workers or poison
+// rendezvous state.  PING stays open: it reveals only liveness and the
+// supervisor's health checks use it before the record (and token) exist.
+// The token rides the env, not argv, so it never shows in /proc cmdline.
 //
 // Build: make (g++ -O2 -std=c++17 -pthread).  Run: dlcfn-broker <port>.
 
@@ -42,6 +53,7 @@
 #include <atomic>
 #include <chrono>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -73,6 +85,18 @@ std::map<std::string, Queue> g_queues;
 std::map<std::string, std::string> g_kv;
 std::atomic<uint64_t> g_seq{0};
 std::atomic<uint64_t> g_id{0};
+std::string g_token;  // empty = open broker (dev/test direct spawns)
+
+// Constant-time comparison: the token check must not leak prefix length
+// through timing.
+bool token_matches(const std::string& candidate) {
+  if (candidate.size() != g_token.size()) return false;
+  unsigned char diff = 0;
+  for (size_t i = 0; i < g_token.size(); i++)
+    diff |= static_cast<unsigned char>(candidate[i]) ^
+            static_cast<unsigned char>(g_token[i]);
+  return diff == 0;
+}
 
 std::string next_id(const char* prefix) {
   char buf[32];
@@ -204,13 +228,33 @@ bool op_unset(const std::string& key) {
 
 void serve(int fd) {
   std::string line;
+  bool authed = g_token.empty();
   while (read_line(fd, line)) {
     std::istringstream ss(line);
     std::string cmd;
     ss >> cmd;
     if (cmd == "PING") {
       if (!write_all(fd, "PONG\n")) break;
-    } else if (cmd == "SEND") {
+      continue;
+    }
+    if (cmd == "AUTH") {
+      std::string candidate;
+      ss >> candidate;
+      if (g_token.empty() || token_matches(candidate)) {
+        authed = true;
+        if (!write_all(fd, "OK\n")) break;
+        continue;
+      }
+      write_all(fd, "ERR bad token\n");
+      break;  // close: no retry credit on one connection
+    }
+    if (!authed) {
+      // Every state verb is gated; close so an unauthenticated peer
+      // cannot probe the command surface.
+      write_all(fd, "ERR auth required\n");
+      break;
+    }
+    if (cmd == "SEND") {
       std::string qname;
       size_t len = 0;
       ss >> qname >> len;
@@ -323,6 +367,8 @@ void accept_loop(int listener) {
 //   (loopback + the advertise interface) so an auto-provisioned control
 //   plane is never exposed on every interface of the operator host.
 int main(int argc, char** argv) {
+  if (const char* tok = std::getenv("DLCFN_BROKER_TOKEN"))
+    g_token = tok;
   int port = argc > 1 ? std::atoi(argv[1]) : 8477;
   std::string addrs_arg = argc > 2 ? argv[2] : "*";
   std::vector<std::string> addrs;

@@ -165,9 +165,13 @@ if has coco; then
     --annotations "$SRC/coco/instances_val2017.json" \
     --out "$WORK/data/coco" --size "$SIZE" --split train --masks \
     > "$WORK/convert-coco-train.json"
+  # Val masks at stride 2: COCO mask mAP is scored at image resolution,
+  # so the GT rasters backing the claimed number are high-fidelity
+  # (train stays at stride 8, the prototype-loss resolution).
   $DLCFN convert --format coco --src "$SRC/coco/val" \
     --annotations "$SRC/coco/instances_val2017.json" \
     --out "$WORK/data/coco" --size "$SIZE" --split val --masks \
+    --mask-stride 2 \
     > "$WORK/convert-coco-val.json"
   record convert_coco_train "$WORK/convert-coco-train.json"
   record convert_coco_val "$WORK/convert-coco-val.json"

@@ -225,3 +225,53 @@ def test_concurrent_clients_stress(broker):
     # fail the test, not silently time out of join().
     assert not any(t.is_alive() for t in threads), "worker thread hung"
     assert not errors, errors
+
+
+def test_auth_gates_every_state_verb():
+    """A token-spawned broker is the IAM-gated control plane analog
+    (deeplearning.template:193-197): PING stays open for liveness, but
+    registering (SEND), polling (RECV), and rendezvous reads/writes
+    (GET/SET) all require the AUTH handshake — a wrong or missing token
+    is rejected and the connection closed."""
+    from deeplearning_cfn_tpu.cluster.broker_client import (
+        BrokerConnection,
+        BrokerError,
+    )
+
+    with BrokerProcess(token="s3cret-tok") as b:
+        # Liveness is checkable without credentials.
+        bare = BrokerConnection("127.0.0.1", b.port, token="")
+        assert bare.ping()
+        # ...but no state verb works: rejected, connection closed.
+        with pytest.raises(BrokerError):
+            bare.send("q", b"register-me")
+        bare2 = BrokerConnection("127.0.0.1", b.port, token="")
+        with pytest.raises(BrokerError):
+            bare2.receive("q", 10, 0)
+        bare3 = BrokerConnection("127.0.0.1", b.port, token="")
+        with pytest.raises(BrokerError):
+            bare3.get("signal:cluster-ready:x")
+        # A wrong token fails the handshake itself.
+        with pytest.raises(BrokerError, match="AUTH rejected"):
+            BrokerConnection("127.0.0.1", b.port, token="wrong-tok")
+        # The right token unlocks the full protocol.
+        q = b.queue("authq")
+        q.send({"event": "ready"})
+        msgs = q.receive(max_messages=1, visibility_timeout_s=60)
+        assert msgs[0].body == {"event": "ready"}
+        good = BrokerConnection("127.0.0.1", b.port, token="s3cret-tok")
+        good.set("signal:x", b"SUCCESS")
+        assert good.get("signal:x") == b"SUCCESS"
+        good.close()
+
+
+def test_open_broker_accepts_token_bearing_clients():
+    """Back-compat: clients carrying an ambient token must still talk to
+    an open (dev/test) broker — AUTH is accepted as a no-op."""
+    from deeplearning_cfn_tpu.cluster.broker_client import BrokerConnection
+
+    with BrokerProcess() as b:
+        conn = BrokerConnection("127.0.0.1", b.port, token="whatever")
+        conn.set("k", b"v")
+        assert conn.get("k") == b"v"
+        conn.close()

@@ -107,3 +107,27 @@ def test_hf_import_contract_at_8b_shapes():
     got = jax.tree_util.tree_map(lambda x: x.shape, params)
     want = jax.tree_util.tree_map(lambda x: x.shape, ref_shapes)
     assert got == want
+
+
+def test_adafactor_memory_term_is_factored():
+    """The memory model's adafactor term must be O(rows+cols), not
+    O(params): the analytic basis for the >2B on-chip ladder rung."""
+    from deeplearning_cfn_tpu.models.llama import LlamaConfig
+    from deeplearning_cfn_tpu.models.llama_memory import memory_report
+
+    cfg = LlamaConfig.b3(seq_len=1024)
+    adamw = memory_report(
+        cfg, {"fsdp": 1}, batch_global=4, seq_len=1024, optimizer="adamw"
+    )
+    ada = memory_report(
+        cfg, {"fsdp": 1}, batch_global=4, seq_len=1024, optimizer="adafactor"
+    )
+    # Factored state is < 1% of adamw's moment bytes at this scale.
+    assert ada.optimizer_gib < 0.01 * adamw.optimizer_gib
+    # The headline consequence: b3 cannot fit a 16 GiB chip under adamw
+    # but fits with margin under adafactor.
+    assert not adamw.fits("v5litepod")
+    assert ada.fits("v5litepod")
+    # Everything except the optimizer term is identical.
+    assert ada.params_gib == adamw.params_gib
+    assert ada.gradients_gib == adamw.gradients_gib

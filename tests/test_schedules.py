@@ -189,9 +189,18 @@ def test_random_crop_pad_recipe_for_same_size_records():
     # Padding introduces zero borders for off-center crops; content is
     # preserved where the window overlaps the original.
     assert out[0].x.max() == 7
-    # pad=0 and same size = pass-through (no copy, no change).
+    # pad=0 and same size = values pass through unchanged, but in a FRESH
+    # array: crop outputs are documented in-place-safe, and the flip stage
+    # relies on it (mutating the source would corrupt the loader's reused
+    # decode buffer, ADVICE r4).
     out_id = list(random_crop_batches(_batches(x), (32, 32), pad=0))
     assert np.array_equal(out_id[0].x, x)
+    assert not np.shares_memory(out_id[0].x, x)
+    from deeplearning_cfn_tpu.train.datasets import center_crop_batches
+
+    out_cc = list(center_crop_batches(_batches(x), (32, 32)))
+    assert np.array_equal(out_cc[0].x, x)
+    assert not np.shares_memory(out_cc[0].x, x)
 
 
 def test_random_crop_rejects_too_small_records():
