@@ -58,12 +58,21 @@ class BrokerConnection:
         if token is None:
             token = os.environ.get("DLCFN_BROKER_TOKEN") or None
         if token:
-            if any(c.isspace() for c in token):
-                raise BrokerError("broker token must not contain whitespace")
-            self.sock.sendall(f"AUTH {token}\n".encode())
-            resp = self._read_line()
-            if resp != "OK":
-                raise BrokerError(f"broker AUTH rejected: {resp}")
+            # A failed handshake must not leak the connected socket: an
+            # agent's bootstrap retry loop would otherwise accumulate one
+            # fd per attempt until EMFILE masks the real auth failure.
+            try:
+                if any(c.isspace() for c in token):
+                    raise BrokerError(
+                        "broker token must not contain whitespace"
+                    )
+                self.sock.sendall(f"AUTH {token}\n".encode())
+                resp = self._read_line()
+                if resp != "OK":
+                    raise BrokerError(f"broker AUTH rejected: {resp}")
+            except BaseException:
+                self.close()
+                raise
 
     def close(self) -> None:
         try:

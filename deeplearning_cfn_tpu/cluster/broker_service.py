@@ -100,13 +100,21 @@ def broker_token(cluster_name: str, root: Path | None = None) -> str | None:
 
 
 def _write_record(rec: Path, payload: dict) -> None:
-    """Write the broker record operator-only: it now carries the AUTH
-    token, which must not be world-readable on a shared host."""
-    rec.write_text(json.dumps(payload))
+    """Write the broker record operator-only: it carries the AUTH token,
+    which must never be world-readable on a shared host — not even for
+    the umask window between create and chmod.  A fresh 0600 inode is
+    created and atomically renamed over the record, so readers see
+    either the old record or the new one, never a partial write or a
+    permissive mode."""
+    tmp = rec.with_suffix(".tmp")
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
     try:
-        os.chmod(rec, 0o600)
-    except OSError:
-        pass
+        with os.fdopen(fd, "w") as fh:
+            fh.write(json.dumps(payload))
+    except BaseException:
+        tmp.unlink(missing_ok=True)
+        raise
+    os.replace(tmp, rec)
 
 
 def _bind_addresses(advertise: str | None) -> str:
@@ -246,6 +254,11 @@ def ensure_broker(
             "recorded broker for %s at %s:%s is dead; starting a new one",
             cluster_name, existing["host"], existing["port"],
         )
+        # Preserve the dead broker's AUTH token: VMs provisioned against
+        # it hold that token in instance metadata, and a crash-restart of
+        # the operator host must let them re-converge, not lock them out.
+        if reuse_token is None:
+            reuse_token = existing.get("token") or None
         rec.unlink(missing_ok=True)
 
     build_broker()

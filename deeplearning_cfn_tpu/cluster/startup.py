@@ -176,10 +176,14 @@ def _agent_step(spec: ClusterSpec) -> list[str]:
         '[ -n "$DLCFN_BROKER" ] && break; sleep 2; done',
         'if [ -z "$DLCFN_BROKER" ]; then '
         "echo 'ERROR: broker address unavailable (metadata + env)'; exit 1; fi",
-        # AUTH token rides the same metadata channel.  Optional (no hard
-        # fail): an open broker — older stack, dev backend — has none, and
-        # an auth-required broker will reject the agent loudly anyway.
-        f'DLCFN_BROKER_TOKEN="${{DLCFN_BROKER_TOKEN:-$({md}attributes/dlcfn-broker-token || true)}}"',
+        # AUTH token rides the same metadata channel, with the same
+        # retry discipline as the address fetch (transient metadata-server
+        # unavailability at boot must not strand an auth-required
+        # cluster).  Still optional after the retries: an open broker —
+        # older stack, dev backend — has none.
+        'for _i in 1 2 3 4 5; do '
+        f'DLCFN_BROKER_TOKEN="${{DLCFN_BROKER_TOKEN:-$({md}attributes/dlcfn-broker-token || true)}}"; '
+        '[ -n "$DLCFN_BROKER_TOKEN" ] && break; sleep 2; done',
         # Slice ordinal (multi-slice: one queued resource per slice, each
         # with its own worker 0) — only slice 0's worker 0 coordinates.
         f'DLCFN_SLICE="${{DLCFN_SLICE:-$({md}attributes/dlcfn-slice || true)}}"',

@@ -340,7 +340,9 @@ class Trainer:
         )
 
     # --- the step -------------------------------------------------------
-    def _build_step(self):
+    def _raw_step_fn(self):
+        """The unjitted single-step body, shared by the jitted step and
+        the multi-step scan so their semantics cannot drift."""
         loss_fn = self._loss
         if self.config.remat:
             loss_fn = jax.checkpoint(loss_fn)
@@ -368,10 +370,50 @@ class Trainer:
             metrics = {"loss": loss, **aux}
             return new_state, metrics
 
+        return step_fn
+
+    def _build_step(self):
         assert self.state_shardings is not None, "call init() before train_step"
         return jax.jit(
-            step_fn,
+            self._raw_step_fn(),
             in_shardings=(self.state_shardings, self.batch_sharding, self.batch_sharding),
+            out_shardings=(self.state_shardings, None),
+            donate_argnums=(0,),
+        )
+
+    def multi_step_fn(self, k: int):
+        """One compiled program executing ``k`` consecutive train steps
+        (lax.scan over batches stacked on a leading [k] axis) — the only
+        expressible form of cross-iteration fusion under XLA: separate
+        dispatches are separate executables, so a compiler can only
+        overlap or reuse across an iteration boundary when both
+        iterations live in ONE module.  Returns a jitted
+        ``(state, xs[k,B,...], ys[k,...]) -> (state, losses[k])``.
+
+        Measured at the ResNet-50 bench shape (docs/BENCH_NOTES.md r5):
+        the candidate savings are param/optimizer re-reads, which are
+        <1% of the step's HBM traffic — activation bytes dominate and
+        are batch-unique, so no cross-iteration reuse exists for them.
+        """
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        raw = self._raw_step_fn()
+
+        def k_steps(state: TrainState, xs: jax.Array, ys: jax.Array):
+            def body(st, xy):
+                st, metrics = raw(st, xy[0], xy[1])
+                return st, metrics["loss"]
+
+            state, losses = jax.lax.scan(body, state, (xs, ys))
+            return state, losses
+
+        assert self.state_shardings is not None, "call init() before multi_step_fn"
+        stacked = NamedSharding(
+            self.mesh, P(None, *self.batch_sharding.spec)
+        )
+        return jax.jit(
+            k_steps,
+            in_shardings=(self.state_shardings, stacked, stacked),
             out_shardings=(self.state_shardings, None),
             donate_argnums=(0,),
         )

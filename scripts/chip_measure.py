@@ -1,0 +1,80 @@
+"""One-shot on-chip measurement: python chip_measure.py <mode> [args]
+
+Modes:
+  throughput <size> <batch> <seq> [fused|adafactor]  — warmup+timed train steps
+  fit <size> <batch> <seq> [adafactor]               — init + 2 steps; FITS/OOM
+
+The optional trailing token selects the qkv-fusion variant or the
+adafactor optimizer (the memory-lean rung that admits --size 3b on the
+16 GiB chip; adamw cannot hold its moment state at that scale).
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning_cfn_tpu.examples.common import enable_compile_cache
+from deeplearning_cfn_tpu.models import llama
+from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
+from deeplearning_cfn_tpu.train.trainer import TrainerConfig
+
+enable_compile_cache()
+
+mode, size, batch, seq = sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4])
+fused = "fused" in sys.argv[5:]
+optimizer = "adafactor" if "adafactor" in sys.argv[5:] else "adamw"
+
+cfg = {"435m": llama.LlamaConfig.m435, "1b": llama.LlamaConfig.b1,
+       "3b": llama.LlamaConfig.b3}[size](seq_len=seq)
+if fused:
+    import dataclasses
+    cfg = dataclasses.replace(cfg, fused_qkv=True)
+
+mesh = build_mesh(MeshSpec.fsdp_parallel(len(jax.devices())))
+trainer = llama.make_trainer(
+    cfg, mesh, TrainerConfig(strategy="fsdp", optimizer=optimizer, learning_rate=1e-4)
+)
+rng = np.random.default_rng(0)
+tok = jax.device_put(
+    jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32),
+    trainer.batch_sharding,
+)
+tgt = jax.device_put(jnp.roll(tok, -1, axis=1), trainer.batch_sharding)
+
+try:
+    state = trainer.init(jax.random.key(0), tok[:1])
+    if mode == "fit":
+        for _ in range(2):
+            state, metrics = trainer.train_step(state, tok, tgt)
+        loss = float(metrics["loss"])
+        print(json.dumps({"mode": "fit", "size": size, "batch": batch,
+                          "seq": seq, "result": "FITS", "loss": round(loss, 3)}))
+        sys.exit(0)
+    WARM, MEAS = 3, 10
+    for _ in range(WARM):
+        state, metrics = trainer.train_step(state, tok, tgt)
+    float(metrics["loss"])  # forced readback: relay block_until_ready lies
+    t0 = time.perf_counter()
+    for _ in range(MEAS):
+        state, metrics = trainer.train_step(state, tok, tgt)
+    loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    toks = batch * seq * MEAS / dt
+    flops_tok = llama.train_flops_per_token(cfg, seq)
+    mfu = flops_tok * batch * seq * MEAS / dt / 197e12
+    print(json.dumps({
+        "mode": "throughput", "size": size, "batch": batch, "seq": seq,
+        "fused": fused, "optimizer": optimizer, "tokens_per_sec": round(toks, 1),
+        "ms_per_step": round(1000 * dt / MEAS, 1), "mfu": round(mfu, 4),
+        "loss": round(loss, 3),
+    }))
+except Exception as e:
+    msg = str(e)
+    oom = "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg or "exceeds" in msg
+    print(json.dumps({"mode": mode, "size": size, "batch": batch, "seq": seq,
+                      "result": "OOM" if oom else "ERROR",
+                      "detail": msg[:300]}))
+    sys.exit(2)

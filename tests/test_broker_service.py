@@ -147,6 +147,34 @@ def test_ensure_broker_spawns_with_auth_token(tmp_path):
         teardown_broker("svc", root=tmp_path)
 
 
+def test_dead_broker_restart_preserves_token(tmp_path):
+    """A crashed broker (or rebooted operator host) must come back with
+    the SAME AUTH token: live VMs hold it in instance metadata, and a
+    regenerated secret would permanently lock them out of their own
+    control plane."""
+    import os
+    import signal
+    import time
+
+    from deeplearning_cfn_tpu.cluster.broker_service import broker_token
+
+    _, port, _ = ensure_broker("svc", root=tmp_path)
+    try:
+        token = broker_token("svc", root=tmp_path)
+        rec = json.loads((tmp_path / "broker" / "svc.json").read_text())
+        os.kill(int(rec["pid"]), signal.SIGKILL)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if not broker_status("svc", root=tmp_path)["alive"]:
+                break
+            time.sleep(0.05)
+        _, port2, started2 = ensure_broker("svc", root=tmp_path)
+        assert started2 is True
+        assert broker_token("svc", root=tmp_path) == token
+    finally:
+        teardown_broker("svc", root=tmp_path)
+
+
 def test_restart_unions_previous_binds(tmp_path):
     """A bind-widening restart must serve the UNION of the old broker's
     interfaces and the new advertise (ADVICE r4): otherwise two CLIs

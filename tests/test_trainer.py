@@ -413,3 +413,47 @@ def test_resnet_norm_validation_and_gcd_groups():
     v = m.init(jax.random.key(0), jnp.zeros((1, 16, 16, 3)), train=True)
     out = m.apply(v, jnp.ones((1, 16, 16, 3)), train=True)
     assert out.shape == (1, 3)
+
+
+def test_multi_step_fn_matches_sequential_steps():
+    """The k-step scan (the XLA-expressible form of cross-iteration
+    fusion) must be numerically identical to k sequential jitted steps —
+    it exists to measure/enable cross-iteration scheduling, never to
+    change semantics."""
+    from deeplearning_cfn_tpu.models.lenet import LeNet
+
+    mesh = build_mesh(MeshSpec.data_parallel(8), jax.devices()[:8])
+
+    def make():
+        return Trainer(
+            LeNet(num_classes=4), mesh,
+            TrainerConfig(learning_rate=0.05, matmul_precision="float32"),
+        )
+
+    ds = SyntheticDataset(shape=(8, 8, 1), num_classes=4, batch_size=16)
+    batches = list(ds.batches(4))
+    xs = np.stack([b.x for b in batches])
+    ys = np.stack([b.y for b in batches])
+
+    t1 = make()
+    s1 = t1.init(jax.random.key(0), jnp.asarray(batches[0].x))
+    losses_seq = []
+    for b in batches:
+        s1, m = t1.train_step(s1, jnp.asarray(b.x), jnp.asarray(b.y))
+        losses_seq.append(float(m["loss"]))
+
+    t2 = make()
+    s2 = t2.init(jax.random.key(0), jnp.asarray(batches[0].x))
+    with jax.set_mesh(mesh):
+        s2, losses = t2.multi_step_fn(4)(s2, jnp.asarray(xs), jnp.asarray(ys))
+    np.testing.assert_allclose(
+        np.asarray(losses), np.asarray(losses_seq), rtol=1e-5
+    )
+    assert int(jax.device_get(s2.step)) == 4
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(s1.params)),
+        jax.tree_util.tree_leaves(jax.device_get(s2.params)),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
