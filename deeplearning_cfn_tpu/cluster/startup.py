@@ -179,11 +179,14 @@ def _agent_step(spec: ClusterSpec) -> list[str]:
         # AUTH token rides the same metadata channel, with the same
         # retry discipline as the address fetch (transient metadata-server
         # unavailability at boot must not strand an auth-required
-        # cluster).  Still optional after the retries: an open broker —
-        # older stack, dev backend — has none.
-        'for _i in 1 2 3 4 5; do '
-        f'DLCFN_BROKER_TOKEN="${{DLCFN_BROKER_TOKEN:-$({md}attributes/dlcfn-broker-token || true)}}"; '
-        '[ -n "$DLCFN_BROKER_TOKEN" ] && break; sleep 2; done',
+        # cluster).  curl exit 22 = an HTTP error (404: the attribute is
+        # legitimately absent — open broker, older stack): stop
+        # immediately instead of burning 10 s of retries on a value that
+        # will never appear; any other failure is transient and retries.
+        'if [ -z "$DLCFN_BROKER_TOKEN" ]; then for _i in 1 2 3 4 5; do '
+        f'_tok="$({md}attributes/dlcfn-broker-token)"; _rc=$?; '
+        'if [ "$_rc" = "0" ]; then DLCFN_BROKER_TOKEN="$_tok"; break; fi; '
+        '[ "$_rc" = "22" ] && break; sleep 2; done; fi',
         # Slice ordinal (multi-slice: one queued resource per slice, each
         # with its own worker 0) — only slice 0's worker 0 coordinates.
         f'DLCFN_SLICE="${{DLCFN_SLICE:-$({md}attributes/dlcfn-slice || true)}}"',

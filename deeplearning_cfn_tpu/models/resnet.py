@@ -51,6 +51,19 @@ class GroupNorm32(nn.Module):
         )(y)
 
 
+class _FoldedNorm(nn.Module):
+    """Identity stand-in for a normalization that has been folded into
+    the preceding convolution's kernel/bias (:func:`fold_batchnorm`).
+    Accepts the same construction surface the blocks use (scale_init=)."""
+
+    dtype: Any = jnp.float32
+    scale_init: Any = nn.initializers.ones
+
+    @nn.compact
+    def __call__(self, y: jnp.ndarray) -> jnp.ndarray:
+        return y
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: tuple[int, int]
@@ -98,11 +111,26 @@ class ResNet(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        if self.norm == "group":
+        if self.norm == "folded":
+            # Inference-only deployment variant: BatchNorm's eval-mode
+            # affine is absorbed into the conv kernels/biases
+            # (:func:`fold_batchnorm` converts a trained "batch" model's
+            # weights).  Training this variant would train WITHOUT
+            # normalization — refuse.
+            if train:
+                raise ValueError(
+                    'norm="folded" is inference-only; train with '
+                    'norm="batch" and fold the result'
+                )
+            conv = partial(nn.Conv, use_bias=True, dtype=self.dtype)
+            norm = partial(_FoldedNorm, dtype=self.dtype)
+        elif self.norm == "group":
             norm = partial(GroupNorm32, dtype=self.dtype)
         elif self.norm != "batch":
             # Silent fallback would train the WRONG experiment.
-            raise ValueError(f"unknown norm {self.norm!r}; expected batch|group")
+            raise ValueError(
+                f"unknown norm {self.norm!r}; expected batch|group|folded"
+            )
         else:
             norm = partial(
                 nn.BatchNorm,
@@ -140,6 +168,59 @@ class ResNet(nn.Module):
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
         return x
+
+
+def fold_batchnorm(params: Any, batch_stats: Any, eps: float = 1e-5) -> Any:
+    """Fold eval-mode BatchNorm into the preceding convolutions:
+    ``W' = W * s`` and ``b' = beta - mean * s`` with
+    ``s = gamma / sqrt(var + eps)`` per output channel.  Input: a trained
+    ``norm="batch"`` model's ``params`` + ``batch_stats``; output: params
+    for the same architecture constructed with ``norm="folded"``
+    (bias-carrying convs, no norm modules).
+
+    The pairing is by the family's naming convention (``convX``/``bnX``
+    within each scope — conv1/bn1 ... conv_proj/bn_proj, conv_init/
+    bn_init), so it holds for every ResNet depth and for the
+    ``return_features`` backbone variant.
+
+    Measured at the bench shape (docs/BENCH_NOTES.md r5): XLA already
+    fuses the eval-mode BN affine into the conv epilogue, so folding is
+    a weight-portability convenience, not a throughput lever.
+    """
+    from collections.abc import Mapping
+
+    def fold_scope(p: Mapping, bs: Mapping) -> dict:
+        out = {}
+        for name, sub in p.items():
+            if name.startswith("conv"):
+                bn = "bn" + name[len("conv"):]
+                if bn in p:
+                    gamma = jnp.asarray(p[bn]["scale"], jnp.float32)
+                    beta = jnp.asarray(p[bn]["bias"], jnp.float32)
+                    mean = jnp.asarray(bs[bn]["mean"], jnp.float32)
+                    var = jnp.asarray(bs[bn]["var"], jnp.float32)
+                    s = gamma / jnp.sqrt(var + eps)
+                    kernel = jnp.asarray(sub["kernel"], jnp.float32)
+                    out[name] = {
+                        "kernel": (kernel * s).astype(sub["kernel"].dtype),
+                        "bias": (beta - mean * s).astype(jnp.float32),
+                    }
+                else:
+                    out[name] = dict(sub)
+            elif name.startswith("bn"):
+                continue  # absorbed
+            # Mapping, not dict: flax FrozenDict scopes (frozen trees,
+            # checkpoint restores) must fold too, not silently pass
+            # through half-converted.
+            elif isinstance(sub, Mapping) and any(
+                k.startswith("conv") for k in sub
+            ):
+                out[name] = fold_scope(sub, bs.get(name, {}))
+            else:
+                out[name] = sub
+        return out
+
+    return fold_scope(params, batch_stats)
 
 
 ResNet50: Callable[..., ResNet] = partial(ResNet, stage_sizes=(3, 4, 6, 3))

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning_cfn_tpu.examples.common import enable_compile_cache
+from deeplearning_cfn_tpu.train.metrics import peak_flops_per_chip
 from deeplearning_cfn_tpu.models import llama
 from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
 from deeplearning_cfn_tpu.train.trainer import TrainerConfig
@@ -64,7 +65,10 @@ try:
     dt = time.perf_counter() - t0
     toks = batch * seq * MEAS / dt
     flops_tok = llama.train_flops_per_token(cfg, seq)
-    mfu = flops_tok * batch * seq * MEAS / dt / 197e12
+    # Device-kind dispatch, not a hardcoded v5e constant: the same
+    # harness must report honest MFU on v4/v5p chips too.
+    peak = peak_flops_per_chip(jax.devices()[0]) or float("nan")
+    mfu = flops_tok * batch * seq * MEAS / dt / peak
     print(json.dumps({
         "mode": "throughput", "size": size, "batch": batch, "seq": seq,
         "fused": fused, "optimizer": optimizer, "tokens_per_sec": round(toks, 1),

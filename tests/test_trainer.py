@@ -457,3 +457,41 @@ def test_multi_step_fn_matches_sequential_steps():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
         )
+
+
+def test_fold_batchnorm_matches_eval_forward():
+    """Conv-BN folding (inference deployment): the folded model — convs
+    carrying W*s and beta-mean*s, no norm modules — reproduces the
+    trained model's eval-mode forward exactly, at every depth scope
+    (init stem, block convs, projection shortcuts)."""
+    from deeplearning_cfn_tpu.models.resnet import ResNet, fold_batchnorm
+
+    rng = np.random.default_rng(0)
+    kwargs = dict(stage_sizes=(1, 1), num_classes=8, num_filters=16,
+                  dtype=jnp.float32)
+    model = ResNet(**kwargs)
+    x = jnp.asarray(rng.standard_normal((4, 32, 32, 3)), jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+    # Perturb params and stats so the fold is exercised for real (fresh
+    # init has mean=0/var=1/gamma∈{0,1}, which a broken fold could pass).
+    params = jax.tree_util.tree_map(
+        lambda a: a + jnp.asarray(rng.normal(0, 0.05, a.shape), a.dtype),
+        variables["params"],
+    )
+    stats = jax.tree_util.tree_map(
+        lambda a: a + jnp.asarray(rng.uniform(0.1, 1.0, a.shape), a.dtype),
+        variables["batch_stats"],
+    )
+    ref = model.apply({"params": params, "batch_stats": stats}, x, train=False)
+
+    folded = ResNet(**kwargs, norm="folded")
+    fparams = fold_batchnorm(params, stats)
+    # Same tree structure as a fresh folded-variant init (loadable).
+    assert jax.tree_util.tree_structure(
+        folded.init(jax.random.key(0), x, train=False)["params"]
+    ) == jax.tree_util.tree_structure(fparams)
+    out = folded.apply({"params": fparams}, x, train=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    # The folded variant refuses to train (it has no normalization).
+    with pytest.raises(ValueError, match="inference-only"):
+        folded.init(jax.random.key(0), x, train=True)
