@@ -183,10 +183,15 @@ def _agent_step(spec: ClusterSpec) -> list[str]:
         # legitimately absent — open broker, older stack): stop
         # immediately instead of burning 10 s of retries on a value that
         # will never appear; any other failure is transient and retries.
-        'if [ -z "$DLCFN_BROKER_TOKEN" ]; then for _i in 1 2 3 4 5; do '
-        f'_tok="$({md}attributes/dlcfn-broker-token)"; _rc=$?; '
+        # `set -u` safety: the variable is usually unset here, so every
+        # reference defaults it; `set -e` safety: the curl assignment runs
+        # under `|| _rc=$?` so a failure reaches the retry logic instead
+        # of aborting the boot script at the assignment.
+        'if [ -z "${DLCFN_BROKER_TOKEN:-}" ]; then for _i in 1 2 3 4 5; do '
+        f'_rc=0; _tok="$({md}attributes/dlcfn-broker-token)" || _rc=$?; '
         'if [ "$_rc" = "0" ]; then DLCFN_BROKER_TOKEN="$_tok"; break; fi; '
         '[ "$_rc" = "22" ] && break; sleep 2; done; fi',
+        'DLCFN_BROKER_TOKEN="${DLCFN_BROKER_TOKEN:-}"',
         # Slice ordinal (multi-slice: one queued resource per slice, each
         # with its own worker 0) — only slice 0's worker 0 coordinates.
         f'DLCFN_SLICE="${{DLCFN_SLICE:-$({md}attributes/dlcfn-slice || true)}}"',

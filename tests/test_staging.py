@@ -127,6 +127,49 @@ class TestStartupScript:
         with pytest.raises(ConfigError, match="bucket"):
             self._spec(staging=StagingSpec(datasets=["d.tar"]))
 
+    @pytest.mark.parametrize(
+        "curl_behavior, want_token",
+        [
+            ("exit 7", ""),  # transient failure every try: proceed tokenless
+            ("exit 22", ""),  # HTTP 404 (open broker): stop retrying, proceed
+            ("echo -n sekrit; exit 0", "sekrit"),  # token present
+        ],
+    )
+    def test_agent_step_token_block_survives_strict_mode(
+        self, tmp_path, curl_behavior, want_token
+    ):
+        """The rendered boot script runs under `set -euo pipefail`
+        (render_startup_script line 2).  The broker-token fetch must not
+        abort the boot when $DLCFN_BROKER_TOKEN is unset (set -u) or when
+        curl fails (set -e kills a failing command substitution used in a
+        bare assignment) — a VM that dies here never joins the cluster.
+        Executes the REAL agent-step lines in bash with curl stubbed."""
+        from deeplearning_cfn_tpu.cluster.startup import _agent_step
+
+        lines = _agent_step(self._spec())
+        assert lines[-1].startswith("exec ")
+        script = "\n".join(
+            ["set -euo pipefail", *lines[:-1], 'echo "REACHED_AGENT token=[${DLCFN_BROKER_TOKEN:-}]"']
+        )
+        bindir = tmp_path / "bin"
+        bindir.mkdir()
+        (bindir / "curl").write_text(f"#!/bin/sh\n{curl_behavior}\n")
+        (bindir / "sleep").write_text("#!/bin/sh\nexit 0\n")  # fast retries
+        for shim in bindir.iterdir():
+            shim.chmod(0o755)
+        env = {
+            "PATH": f"{bindir}:/usr/bin:/bin",
+            # Preset so the index/broker fetch blocks (their own curl is
+            # also stubbed to fail) don't exit before the token block.
+            "DLCFN_WORKER_INDEX": "1",
+            "DLCFN_BROKER": "10.0.0.2:7070",
+        }
+        proc = subprocess.run(
+            ["bash", "-c", script], env=env, capture_output=True, text=True
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert f"REACHED_AGENT token=[{want_token}]" in proc.stdout
+
 
 class TestStager:
     def test_roundtrip(self, tmp_path):
