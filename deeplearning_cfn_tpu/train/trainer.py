@@ -96,14 +96,26 @@ class TrainerConfig:
 
 
 def decay_mask(params: Any) -> Any:
-    """The canonical weight-decay mask: decay only rank>=2 tensors
-    (conv/dense kernels).  Norm scales and every bias are rank 1, so they
-    are excluded — decaying a BatchNorm scale toward zero fights the
-    normalization itself, and the standard 90-epoch ResNet-50 recipe (the
-    one the reference delegated to tensorpack/MXNet, run.sh:92-93)
-    excludes them.  Rank-based, not name-based: it holds for any Flax
-    module tree without pattern-matching parameter paths."""
-    return jax.tree_util.tree_map(lambda p: p.ndim > 1, params)
+    """The canonical weight-decay mask: decay only conv/dense kernels.
+    Norm scales and every bias are excluded — decaying a BatchNorm scale
+    toward zero fights the normalization itself, and the standard 90-epoch
+    ResNet-50 recipe (the one the reference delegated to tensorpack/MXNet,
+    run.sh:92-93) excludes them.
+
+    Rank >= 2 is the base rule (norm scales and biases are rank 1 in any
+    plain Flax module tree), but rank alone is NOT sufficient for
+    scan-stacked parameter trees: the llama family stores per-layer norm
+    scales as one [L, d] rank-2 array (models/llama.py init_params), which
+    a pure rank test would decay.  So paths whose leaf name marks them as
+    norm/bias parameters are excluded at ANY rank."""
+
+    def rule(path, p) -> bool:
+        leaf = str(getattr(path[-1], "key", getattr(path[-1], "name", path[-1]))).lower()
+        if "norm" in leaf or "bias" in leaf or leaf == "scale":
+            return False
+        return p.ndim > 1
+
+    return jax.tree_util.tree_map_with_path(rule, params)
 
 
 def _make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:

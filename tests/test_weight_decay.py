@@ -189,3 +189,20 @@ def test_llama_train_exposes_adafactor():
          "--log_every", "1"]
     )
     assert np.isfinite(out["final_loss"])
+
+
+def test_decay_mask_excludes_stacked_norm_scales():
+    """Scan-stacked trees (llama: per-layer norm scales as ONE [L, d]
+    rank-2 array) defeat a pure rank>=2 mask — the exclusion must hold by
+    path name at any rank, or every RMSNorm scale in the transformer
+    family silently decays toward zero."""
+    from deeplearning_cfn_tpu.models import llama
+    from deeplearning_cfn_tpu.train.trainer import decay_mask
+
+    params = llama.init_params(llama.LlamaConfig.tiny(), jax.random.key(0))
+    mask = decay_mask(params)
+    assert not mask["final_norm"]
+    assert not mask["layers"]["attn_norm"]  # [L, d]: rank 2, still a norm
+    assert not mask["layers"]["mlp_norm"]
+    assert mask["embed"]
+    assert mask["layers"]["wq"] and mask["layers"]["w_down"]
