@@ -105,7 +105,12 @@ def main(argv: list[str] | None = None) -> dict:
     batch = args.global_batch_size or max(1, dp * fsdp) * microbatches
     from deeplearning_cfn_tpu.examples.common import make_lr_schedule
 
-    lr = args.learning_rate or 3e-4
+    # Per-optimizer default: adafactor's factored/clipped updates want a
+    # much larger step than adam-family.  On-chip LR sweep at the 2.9B
+    # rung (equal token budget, held-out ppl): 3e-4 -> 31.8, 1e-3 -> 13.0,
+    # 3e-3 -> 9.6, 1e-2 -> 7.2, 3e-2 -> 8.3 — the knee is 1e-2
+    # (docs/BENCH_NOTES.md round-5 quality table).
+    lr = args.learning_rate or (1e-2 if args.optimizer == "adafactor" else 3e-4)
     trainer = llama.make_trainer(
         cfg,
         mesh,

@@ -44,10 +44,38 @@ def peak_flops_per_chip(device=None) -> float | None:
     wins over 'TPU v5'."""
     d = device if device is not None else jax.devices()[0]
     kind = str(getattr(d, "device_kind", ""))
+    return _longest_prefix(PEAK_BF16_FLOPS_PER_CHIP, kind)
+
+
+# Peak HBM bandwidth per chip (bytes/s, public Cloud TPU figures) — the
+# denominator of MBU (model-bandwidth utilization), the honest headline
+# for autoregressive DECODE the way MFU is for training: each decode step
+# must stream the weights from HBM once, so tokens/s is bandwidth-bound.
+PEAK_HBM_BYTES_PER_CHIP: dict[str, float] = {
+    "TPU v2": 700e9,
+    "TPU v3": 900e9,
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,  # v5p reports "TPU v5"
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
+}
+
+
+def peak_hbm_bytes_per_chip(device=None) -> float | None:
+    """Peak HBM bytes/s for a JAX device, or None when unknown."""
+    d = device if device is not None else jax.devices()[0]
+    kind = str(getattr(d, "device_kind", ""))
+    return _longest_prefix(PEAK_HBM_BYTES_PER_CHIP, kind)
+
+
+def _longest_prefix(table: dict[str, float], kind: str) -> float | None:
     best: tuple[int, float] | None = None
-    for prefix, flops in PEAK_BF16_FLOPS_PER_CHIP.items():
+    for prefix, value in table.items():
         if kind.startswith(prefix) and (best is None or len(prefix) > best[0]):
-            best = (len(prefix), flops)
+            best = (len(prefix), value)
     return best[1] if best is not None else None
 
 

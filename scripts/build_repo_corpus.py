@@ -1,0 +1,62 @@
+"""Build the real-text LM corpus behind BENCH_NOTES "held-out perplexity
+at scale": this repository's own source + docs, byte-level tokenizer,
+fixed windows, DISJOINT FILE SPLIT (val files never contribute a train
+window, so held-out perplexity is genuinely held out).
+
+Usage: python scripts/build_repo_corpus.py --out /tmp/repo_corpus [--seq_len 1024]
+
+Output: <out>/train.dlc + val.dlc (+ layout/tokenizer sidecars) ready for
+``llama_train --data_dir <out>``.  Versioned so the corpus each round's
+perplexity rows train on is rebuildable bit-for-bit from the tree.
+"""
+
+import argparse
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from deeplearning_cfn_tpu.train.datasets import convert_text  # noqa: E402
+
+# Source + docs, no binaries, no goldens (JSON is near-random bytes at the
+# byte level and pads perplexity down), no test fixtures.
+GLOBS = ("deeplearning_cfn_tpu/**/*.py", "native/**/*.cpp", "native/**/*.h",
+         "docs/*.md", "*.md", "scripts/*.py", "tests/*.py")
+VAL_EVERY = 10  # every 10th file (sorted order) is val: ~9% of files
+
+
+def collect_files() -> tuple[list[Path], list[Path]]:
+    files = sorted({p for g in GLOBS for p in REPO.glob(g) if p.is_file()})
+    train = [p for i, p in enumerate(files) if i % VAL_EVERY != VAL_EVERY - 1]
+    val = [p for i, p in enumerate(files) if i % VAL_EVERY == VAL_EVERY - 1]
+    return train, val
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--seq_len", type=int, default=1024)
+    args = ap.parse_args(argv)
+
+    train, val = collect_files()
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    stats = {}
+    with tempfile.TemporaryDirectory() as td:
+        for split, paths in (("train", train), ("val", val)):
+            sdir = Path(td) / split
+            sdir.mkdir()
+            for p in paths:
+                # Flat .txt copies: convert_text globs *.txt one level deep.
+                shutil.copyfile(p, sdir / (str(p.relative_to(REPO)).replace("/", "__") + ".txt"))
+            info = convert_text(sdir, out, seq_len=args.seq_len, split=split)
+            stats[split] = {"files": len(paths), **info}
+    print(stats)
+    return stats
+
+
+if __name__ == "__main__":
+    main()

@@ -3,14 +3,24 @@
 Modes:
   throughput <size> <batch> <seq> [fused|adafactor]  — warmup+timed train steps
   fit <size> <batch> <seq> [adafactor]               — init + 2 steps; FITS/OOM
+  decode <size> <batch> <prompt_len> [new_tokens]    — serving tokens/s + MBU
 
 The optional trailing token selects the qkv-fusion variant or the
 adafactor optimizer (the memory-lean rung that admits --size 3b on the
 16 GiB chip; adamw cannot hold its moment state at that scale).
+
+``decode`` measures the llama_decode.generate path (prefill + lax.scan
+decode, KV cache, greedy): tokens/s and MBU — model-bandwidth
+utilization, param-bytes-only numerator — because each decode step must
+stream the weights from HBM once, bandwidth (not the MXU) is the
+ceiling that matters for serving.
 """
 import json
 import sys
 import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import jax
 import jax.numpy as jnp
@@ -28,11 +38,49 @@ mode, size, batch, seq = sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.arg
 fused = "fused" in sys.argv[5:]
 optimizer = "adafactor" if "adafactor" in sys.argv[5:] else "adamw"
 
+new_tokens = int(sys.argv[5]) if mode == "decode" and len(sys.argv) > 5 else 128
 cfg = {"435m": llama.LlamaConfig.m435, "1b": llama.LlamaConfig.b1,
-       "3b": llama.LlamaConfig.b3}[size](seq_len=seq)
+       "3b": llama.LlamaConfig.b3}[size](
+    # decode: seq is the PROMPT length; the cache needs prompt + new room.
+    seq_len=seq + new_tokens if mode == "decode" else seq
+)
 if fused:
     import dataclasses
     cfg = dataclasses.replace(cfg, fused_qkv=True)
+
+if mode == "decode":
+    from deeplearning_cfn_tpu.models.llama_decode import generate
+    from deeplearning_cfn_tpu.train.metrics import peak_hbm_bytes_per_chip
+
+    batch_, prompt_len = batch, seq  # positional reuse: <batch> <prompt_len>
+    params = llama.init_params(cfg, jax.random.key(0))
+    param_bytes = sum(p.nbytes for p in jax.tree_util.tree_leaves(params))
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(1, cfg.vocab_size, (batch_, prompt_len)), jnp.int32
+    )
+    out = generate(cfg, params, prompt, jax.random.key(1),
+                   max_new_tokens=new_tokens)  # compile + warm
+    out.block_until_ready()
+    REPS = 5
+    t0 = time.perf_counter()
+    for i in range(REPS):
+        out = generate(cfg, params, prompt, jax.random.key(2 + i),
+                       max_new_tokens=new_tokens)
+    np.asarray(out)  # forced readback: relay block_until_ready lies
+    dt = time.perf_counter() - t0
+    toks = batch_ * new_tokens * REPS / dt
+    steps_per_s = new_tokens * REPS / dt
+    peak_bw = peak_hbm_bytes_per_chip() or float("nan")
+    print(json.dumps({
+        "mode": "decode", "size": size, "batch": batch_,
+        "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "param_bytes": param_bytes,
+        "tokens_per_sec": round(toks, 1),
+        "ms_per_token": round(1000 / steps_per_s, 2),
+        "mbu": round(param_bytes * steps_per_s / peak_bw, 4),
+    }))
+    sys.exit(0)
 
 mesh = build_mesh(MeshSpec.fsdp_parallel(len(jax.devices())))
 trainer = llama.make_trainer(

@@ -14,7 +14,11 @@ Run on the real chip: PYTHONPATH=.:$PYTHONPATH python scripts/chip_resnet_multis
 """
 
 import json
+import sys
 import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import jax
 import jax.numpy as jnp

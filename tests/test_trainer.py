@@ -495,3 +495,26 @@ def test_fold_batchnorm_matches_eval_forward():
     # The folded variant refuses to train (it has no normalization).
     with pytest.raises(ValueError, match="inference-only"):
         folded.init(jax.random.key(0), x, train=True)
+
+
+def test_peak_tables_prefix_match():
+    """Device-kind dispatch for the MFU and MBU denominators: known kinds
+    resolve, longest prefix wins ('TPU v5 lite' is an 819 GB/s v5e, not a
+    2765 GB/s v5p), unknown kinds return None so test backends report no
+    utilization instead of a wrong one."""
+    from deeplearning_cfn_tpu.train.metrics import (
+        peak_flops_per_chip,
+        peak_hbm_bytes_per_chip,
+    )
+
+    class FakeDev:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    assert peak_flops_per_chip(FakeDev("TPU v5 lite")) == 197e12
+    assert peak_flops_per_chip(FakeDev("TPU v5")) == 459e12
+    assert peak_hbm_bytes_per_chip(FakeDev("TPU v5 lite")) == 819e9
+    assert peak_hbm_bytes_per_chip(FakeDev("TPU v5")) == 2765e9
+    assert peak_hbm_bytes_per_chip(FakeDev("TPU v4")) == 1228e9
+    assert peak_flops_per_chip(FakeDev("cpu")) is None
+    assert peak_hbm_bytes_per_chip(FakeDev("cpu")) is None
