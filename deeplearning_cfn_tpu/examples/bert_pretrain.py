@@ -17,19 +17,23 @@ from deeplearning_cfn_tpu.examples.common import metrics_sink
 from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
 
 
-def mlm_record_batches(args, cfg, batch: int):
+def mlm_record_batches(args, cfg, batch: int, eval_mode: bool = False):
     """Token DLC1 records (``dlcfn convert --format text``) masked on the
     fly for MLM when --data_dir is set; None = synthetic.  Shares the
     causal-LM ingestion (split policy, sidecar vocab/seq_len contract)
     via common.token_record_loader, reserving one id beyond the data
     vocabulary as the mask token so masks can never collide with real
-    tokens (byte 0x00 / HF id 0 are live vocabulary entries)."""
+    tokens (byte 0x00 / HF id 0 are live vocabulary entries).
+
+    ``eval_mode`` reads the held-out split and draws the masks from a
+    fixed, disjoint seed stream so every evaluation of a checkpoint
+    scores the same masked positions."""
     from deeplearning_cfn_tpu.examples.common import token_record_loader
     from deeplearning_cfn_tpu.train.datasets import mlm_batches
     from deeplearning_cfn_tpu.utils.logging import get_logger
 
     loaded = token_record_loader(
-        args, batch, cfg.vocab_size, reserve_ids=1
+        args, batch, cfg.vocab_size, eval_mode=eval_mode, reserve_ids=1
     )
     if loaded is None:
         return None
@@ -43,7 +47,10 @@ def mlm_record_batches(args, cfg, batch: int):
             "which may collide with a real token; reconvert with "
             "`dlcfn convert --format text` to pin the vocabulary"
         )
-    return lambda steps: mlm_batches(loader, spec, steps, mask_token=mask_token)
+    seed = 10_000 if eval_mode else 0
+    return lambda steps: mlm_batches(
+        loader, spec, steps, mask_token=mask_token, seed=seed
+    )
 
 
 def main(argv: list[str] | None = None) -> dict:
@@ -57,6 +64,11 @@ def main(argv: list[str] | None = None) -> dict:
                    help="override the tiny config's vocabulary (byte-level "
                         "token records need >= 258: 257 data ids + the "
                         "reserved mask id)")
+    p.add_argument("--eval_steps", type=int, default=0,
+                   help="held-out batches for masked-LM quality (loss, "
+                        "masked-token accuracy, perplexity) after training "
+                        "(0 = skip; reads the val/test split of --data_dir "
+                        "when staged, deterministic eval masks)")
     args = p.parse_args(argv)
     maybe_init_distributed()
     if args.tiny:
@@ -114,11 +126,37 @@ def main(argv: list[str] | None = None) -> dict:
     if ckpt:
         ckpt.save(int(state.step), state)
         ckpt.close()
-    return {
+    result = {
         "final_loss": losses[-1],
         "steps": len(losses),
         "first_step_s": first_step_clock(trainer, t_main),
+        "history": logger.history,
     }
+    if args.eval_steps:
+        import math
+
+        eval_batches = mlm_record_batches(args, cfg, batch, eval_mode=True)
+        if eval_batches is None:
+            eval_ds = SyntheticMLMDataset(
+                seq_len=args.seq_len, vocab_size=cfg.vocab_size,
+                batch_size=batch, seed=10_000,
+            )
+            eval_batches, split = eval_ds.batches, "heldout-synthetic"
+        else:
+            from deeplearning_cfn_tpu.examples.common import has_heldout_split
+
+            split = "heldout" if has_heldout_split(args.data_dir) else "train"
+        ev = trainer.evaluate(
+            state, eval_batches(args.eval_steps), steps=args.eval_steps
+        )
+        # Masked-token perplexity: exp of the mean NLL over MASKED
+        # positions (that is what mlm_loss averages) — the MLM analog of
+        # corpus perplexity.  Capped exponent as in llama_train.
+        ev["perplexity"] = (
+            math.exp(min(ev["loss"], 700.0)) if "loss" in ev else None
+        )
+        result["eval"] = {"split": split, **ev}
+    return result
 
 
 if __name__ == "__main__":

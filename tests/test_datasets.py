@@ -477,23 +477,35 @@ def test_llama_heldout_perplexity_on_text_records(tmp_path):
 
 def test_bert_pretrain_on_text_records(tmp_path):
     """MLM over real text records: the masked counterpart of the causal
-    path, through the same ingestion and split policy."""
+    path, through the same ingestion and split policy — including the
+    held-out masked-LM evaluation (loss, masked-token accuracy,
+    perplexity on the val split with deterministic eval masks)."""
     from deeplearning_cfn_tpu.examples.bert_pretrain import main
 
     src = tmp_path / "corpus"
     src.mkdir()
     (src / "a.txt").write_text("lorem ipsum dolor " * 300)
     datasets.convert_text(src, tmp_path / "dlc", seq_len=32)
+    val = tmp_path / "valsrc"
+    val.mkdir()
+    (val / "b.txt").write_text("sit amet consectetur " * 120)
+    datasets.convert_text(val, tmp_path / "dlc", seq_len=32, split="val")
     out = main(
         [
             "--tiny", "--seq_len", "32", "--steps", "3",
             "--vocab_size", "512",
             "--global_batch_size", "8",
             "--data_dir", str(tmp_path / "dlc"),
+            "--eval_steps", "2",
         ]
     )
     assert np.isfinite(out["final_loss"])
     assert out["steps"] == 3
+    ev = out["eval"]
+    assert ev["split"] == "heldout"
+    assert np.isfinite(ev["loss"]) and ev["perplexity"] > 0
+    assert 0.0 <= ev["masked_accuracy"] <= 1.0
+    assert ev["examples"] == 16
 
 
 def test_mlm_batches_mask_semantics(tmp_path):
