@@ -275,3 +275,49 @@ def test_open_broker_accepts_token_bearing_clients():
         conn.set("k", b"v")
         assert conn.get("k") == b"v"
         conn.close()
+
+
+def test_broker_survives_malformed_wire_input(broker):
+    """A network service on the cluster's control path must not crash or
+    wedge on garbage: binary junk, oversized headers, truncated SEND
+    bodies, and nonsense verbs each at worst close THAT connection —
+    liveness and the queue contract keep working for everyone else."""
+    import os
+    import socket
+
+    def raw_conn():
+        s = socket.create_connection(("127.0.0.1", broker.port), timeout=5)
+        s.settimeout(5)
+        return s
+
+    # 1. Pure binary garbage (includes newlines -> parsed as junk verbs).
+    s = raw_conn()
+    s.sendall(os.urandom(4096))
+    s.close()
+    # 2. An unbounded header: the 64 KiB line sanity bound must cut it off.
+    s = raw_conn()
+    try:
+        s.sendall(b"A" * (1 << 17))
+        s.close()
+    except (BrokenPipeError, ConnectionResetError):
+        pass  # server already dropped us mid-send: the bound worked
+    # 3. A SEND that promises a body and never delivers (truncated).
+    s = raw_conn()
+    s.sendall(b"SEND q 1048576\ntiny")
+    s.close()
+    # 4. Negative / non-numeric argument fields.
+    for line in (b"RECV q -5 -9999\n", b"SEND q notanumber\n", b"RECV\n"):
+        s = raw_conn()
+        s.sendall(line)
+        try:
+            s.recv(256)
+        except (TimeoutError, ConnectionResetError, OSError):
+            pass
+        s.close()
+
+    # The broker is alive and the contract still holds for real clients.
+    q = broker.queue("post-fuzz")
+    q.send({"still": "working"})
+    msgs = q.receive(max_messages=1, visibility_timeout_s=60)
+    assert msgs[0].body == {"still": "working"}
+    q.delete(msgs[0].receipt)
