@@ -77,7 +77,9 @@ if mode == "decode":
         "prompt_len": prompt_len, "new_tokens": new_tokens,
         "param_bytes": param_bytes,
         "tokens_per_sec": round(toks, 1),
-        "ms_per_token": round(1000 / steps_per_s, 2),
+        # Per decode STEP (= per token per stream); at B>1 each step
+        # serves B tokens, which is what tokens_per_sec aggregates.
+        "ms_per_step": round(1000 / steps_per_s, 2),
         "mbu": round(param_bytes * steps_per_s / peak_bw, 4),
     }))
     sys.exit(0)
