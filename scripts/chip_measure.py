@@ -59,28 +59,46 @@ if mode == "decode":
     prompt = jnp.asarray(
         rng.integers(1, cfg.vocab_size, (batch_, prompt_len)), jnp.int32
     )
+    assert new_tokens > 1, "decode mode needs >= 2 new tokens"
     out = generate(cfg, params, prompt, jax.random.key(1),
                    max_new_tokens=new_tokens)  # compile + warm
-    out.block_until_ready()
+    np.asarray(out)
+    # Prefill probe: same prompt, ONE new token.  Subtracting its time
+    # isolates the decode steps — otherwise every rep charges a full
+    # prefill to the per-step and MBU numbers, understating both (the
+    # more the longer the prompt).
+    pre = generate(cfg, params, prompt, jax.random.key(1), max_new_tokens=1)
+    np.asarray(pre)
     REPS = 5
     t0 = time.perf_counter()
     for i in range(REPS):
         out = generate(cfg, params, prompt, jax.random.key(2 + i),
                        max_new_tokens=new_tokens)
     np.asarray(out)  # forced readback: relay block_until_ready lies
-    dt = time.perf_counter() - t0
-    toks = batch_ * new_tokens * REPS / dt
-    steps_per_s = new_tokens * REPS / dt
+    dt_full = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for i in range(REPS):
+        pre = generate(cfg, params, prompt, jax.random.key(2 + i),
+                       max_new_tokens=1)
+    np.asarray(pre)
+    dt_pre = time.perf_counter() - t0
+    # Relay wall-time variance can make the subtraction go negative on
+    # short-prompt shapes; floor at 10% of the naive step time.
+    naive = dt_full / (REPS * new_tokens)
+    step_s = max((dt_full - dt_pre) / (REPS * (new_tokens - 1)), 0.1 * naive)
+    toks = batch_ * new_tokens * REPS / dt_full  # end-to-end incl. prefill
     peak_bw = peak_hbm_bytes_per_chip() or float("nan")
     print(json.dumps({
         "mode": "decode", "size": size, "batch": batch_,
         "prompt_len": prompt_len, "new_tokens": new_tokens,
         "param_bytes": param_bytes,
         "tokens_per_sec": round(toks, 1),
-        # Per decode STEP (= per token per stream); at B>1 each step
-        # serves B tokens, which is what tokens_per_sec aggregates.
-        "ms_per_step": round(1000 / steps_per_s, 2),
-        "mbu": round(param_bytes * steps_per_s / peak_bw, 4),
+        "prefill_ms": round(1000 * dt_pre / REPS, 2),
+        # Per decode STEP (= per token per stream), prefill-subtracted;
+        # at B>1 each step serves B tokens, which is what
+        # tokens_per_sec aggregates.
+        "ms_per_step": round(1000 * step_s, 2),
+        "mbu": round(param_bytes / step_s / peak_bw, 4),
     }))
     sys.exit(0)
 
