@@ -66,6 +66,11 @@ def main(argv: list[str] | None = None) -> dict:
                    help="held-out batches for corpus perplexity after "
                         "training (0 = skip; reads the val/test split of "
                         "--data_dir when staged)")
+    p.add_argument("--grad_accum", type=int, default=1,
+                   help="microbatches per optimizer update (ONE compiled "
+                        "step scans them, so only a single microbatch's "
+                        "activations are live): fits effective batches "
+                        "the chip's HBM cannot hold at once")
     args = p.parse_args(argv)
     maybe_init_distributed()
 
@@ -124,6 +129,7 @@ def main(argv: list[str] | None = None) -> dict:
             lr_schedule=make_lr_schedule(args, lr),
             weight_decay=args.weight_decay if args.weight_decay is not None else 0.1,
             grad_clip_norm=1.0,
+            grad_accum_steps=args.grad_accum,
             log_every=args.log_every,
         ),
     )

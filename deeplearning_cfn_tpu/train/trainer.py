@@ -93,6 +93,16 @@ class TrainerConfig:
     label_smoothing: float = 0.0
     lr_schedule: optax.Schedule | None = None
     log_every: int = 10
+    # Gradient accumulation: the step's batch is split into this many
+    # microbatches, gradients are averaged across them inside ONE
+    # compiled step (lax.scan), and the optimizer updates once — a
+    # batch-size-for-wallclock trade that fits effective batches the
+    # chip's HBM cannot hold in one activation footprint.  Microbatches
+    # are STRIDED slices (x[a::k]) so each one spans every data shard;
+    # contiguous chunks would leave most devices idle per microbatch.
+    # Distinct from Trainer.multi_step_fn(k): that is k optimizer
+    # updates per dispatch, this is one update from k part-gradients.
+    grad_accum_steps: int = 1
 
 
 def decay_mask(params: Any) -> Any:
@@ -165,6 +175,49 @@ def _make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
         chain.append(optax.add_decayed_weights(cfg.weight_decay, mask=decay_mask))
     chain.append(tx)
     return optax.chain(*chain) if len(chain) > 1 else tx
+
+
+def _accumulated_grads(loss_fn, state, x, y, accum: int):
+    """Mean loss/aux/gradients over ``accum`` strided microbatches,
+    computed by one lax.scan so only a single microbatch's activations
+    are ever live.  Microbatch ``a`` is ``leaf[a::accum]`` — the strided
+    view keeps every data shard populated in every microbatch (a
+    contiguous split would park whole microbatches on a subset of
+    devices).  BatchNorm-style collections thread through sequentially,
+    exactly as they would across real steps."""
+
+    def to_micro(leaf):
+        n = leaf.shape[0]
+        if n % accum:
+            raise ValueError(
+                f"batch axis {n} not divisible by grad_accum_steps={accum}"
+            )
+        # leaf[a::accum] == reshape(n//accum, accum, ...)[:, a]; moving
+        # the accum axis first gives scan its [accum, micro, ...] xs.
+        return jnp.swapaxes(
+            leaf.reshape((n // accum, accum) + leaf.shape[1:]), 0, 1
+        )
+
+    xs = jax.tree_util.tree_map(to_micro, x)
+    ys = jax.tree_util.tree_map(to_micro, y)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def body(carry, xy):
+        grads_acc, model_state = carry
+        x_m, y_m = xy
+        (loss, (aux, model_state)), grads = grad_fn(
+            state.params, model_state, x_m, y_m
+        )
+        grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+        return (grads_acc, model_state), (loss, aux)
+
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+    (grads_sum, new_model_state), (losses, auxes) = jax.lax.scan(
+        body, (zeros, state.model_state), (xs, ys)
+    )
+    grads = jax.tree_util.tree_map(lambda g: g / accum, grads_sum)
+    aux = jax.tree_util.tree_map(lambda v: jnp.mean(v, axis=0), auxes)
+    return jnp.mean(losses), aux, new_model_state, grads
 
 
 def softmax_xent(logits: jax.Array, labels: jax.Array, smoothing: float = 0.0) -> jax.Array:
@@ -361,6 +414,10 @@ class Trainer:
 
         precision = self.config.matmul_precision
 
+        accum = self.config.grad_accum_steps
+        if accum < 1:
+            raise ValueError(f"grad_accum_steps must be >= 1, got {accum}")
+
         def step_fn(state: TrainState, x: jax.Array, y: jax.Array):
             ctx = (
                 jax.default_matmul_precision(precision)
@@ -368,9 +425,16 @@ class Trainer:
                 else contextlib.nullcontext()
             )
             with ctx:
-                (loss, (aux, new_model_state)), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True
-                )(state.params, state.model_state, x, y)
+                if accum == 1:
+                    (loss, (aux, new_model_state)), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True
+                    )(state.params, state.model_state, x, y)
+                    metrics = {"loss": loss, **aux}
+                else:
+                    loss, aux, new_model_state, grads = _accumulated_grads(
+                        loss_fn, state, x, y, accum
+                    )
+                    metrics = {"loss": loss, **aux}
             updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
             new_state = TrainState(
@@ -379,7 +443,6 @@ class Trainer:
                 opt_state=new_opt,
                 model_state=new_model_state,
             )
-            metrics = {"loss": loss, **aux}
             return new_state, metrics
 
         return step_fn

@@ -518,3 +518,95 @@ def test_peak_tables_prefix_match():
     assert peak_hbm_bytes_per_chip(FakeDev("TPU v4")) == 1228e9
     assert peak_flops_per_chip(FakeDev("cpu")) is None
     assert peak_hbm_bytes_per_chip(FakeDev("cpu")) is None
+
+
+class TestGradAccumulation:
+    """grad_accum_steps=k: ONE optimizer update from k microbatch
+    gradients inside one compiled step — the memory-for-wallclock trade
+    for effective batches the chip cannot hold.  (multi_step_fn is the
+    other composition: k updates per dispatch.)"""
+
+    def _fit_once(self, accum, strategy="fsdp", steps=3):
+        mesh = build_mesh(MeshSpec(fsdp=8) if strategy == "fsdp" else MeshSpec(dp=8))
+        # Momentum, not adam: the momentum update is LINEAR in the
+        # gradient, so float-level reduction-order noise stays float-level
+        # in the params.  Adam's step-1 update is ~sign(g) and flips on
+        # near-zero gradient elements, which would demand a loose
+        # tolerance that could hide real bugs.
+        trainer = Trainer(
+            LeNet(),
+            mesh,
+            TrainerConfig(
+                optimizer="momentum", learning_rate=1e-2, weight_decay=1e-4,
+                strategy=strategy,
+                matmul_precision="float32", grad_accum_steps=accum,
+            ),
+        )
+        ds = SyntheticDataset(batch_size=32, num_classes=10)
+        batches = list(ds.batches(steps))
+        state = trainer.init(jax.random.key(0), jnp.asarray(batches[0].x))
+        for b in batches:
+            state, metrics = trainer.train_step(
+                state, jnp.asarray(b.x), jnp.asarray(b.y)
+            )
+        return state, metrics
+
+    def test_accumulated_matches_full_batch(self):
+        """Mean-of-microbatch-gradients equals the full-batch gradient
+        (the objective is batch-mean), so k=4 must reproduce k=1 to
+        float tolerance — same loss, same updated params."""
+        s1, m1 = self._fit_once(accum=1)
+        s4, m4 = self._fit_once(accum=4)
+        assert abs(float(m1["loss"]) - float(m4["loss"])) < 1e-5
+        for a, b in zip(
+            jax.tree_util.tree_leaves(s1.params),
+            jax.tree_util.tree_leaves(s4.params),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-6, rtol=2e-6
+            )
+
+    def test_accum_with_batchnorm_state(self):
+        """Mutable collections thread through the microbatch scan: the
+        running stats move and training still learns."""
+        from deeplearning_cfn_tpu.models.resnet import ResNet
+
+        mesh = build_mesh(MeshSpec(dp=8))
+        model = ResNet(stage_sizes=(1,), num_classes=4, num_filters=8)
+        trainer = Trainer(
+            model, mesh,
+            TrainerConfig(optimizer="momentum", learning_rate=0.05,
+                          matmul_precision="float32", grad_accum_steps=2),
+        )
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((16, 32, 32, 3)), jnp.float32)
+        y = jnp.asarray(rng.integers(0, 4, 16), jnp.int32)
+        state = trainer.init(jax.random.key(0), x)
+        # Materialize BEFORE the first step: train_step donates its state,
+        # so the original device buffers die with the first update.
+        stats0 = [
+            np.asarray(l) for l in jax.tree_util.tree_leaves(state.model_state)
+        ]
+        first = None
+        for _ in range(10):
+            state, metrics = trainer.train_step(state, x, y)
+            if first is None:
+                first = float(metrics["loss"])
+        assert float(metrics["loss"]) < first
+        moved = any(
+            not np.allclose(np.asarray(a), np.asarray(b))
+            for a, b in zip(stats0, jax.tree_util.tree_leaves(state.model_state))
+        )
+        assert moved, "BatchNorm stats never updated under accumulation"
+
+    def test_indivisible_batch_fails_loudly(self):
+        mesh = build_mesh(MeshSpec(dp=8))
+        trainer = Trainer(
+            LeNet(), mesh,
+            TrainerConfig(optimizer="sgd", grad_accum_steps=3),
+        )
+        ds = SyntheticDataset(batch_size=32, num_classes=10)
+        b = next(iter(ds.batches(1)))
+        state = trainer.init(jax.random.key(0), jnp.asarray(b.x))
+        with pytest.raises(ValueError, match="not divisible"):
+            trainer.train_step(state, jnp.asarray(b.x), jnp.asarray(b.y))
