@@ -429,12 +429,11 @@ class Trainer:
                     (loss, (aux, new_model_state)), grads = jax.value_and_grad(
                         loss_fn, has_aux=True
                     )(state.params, state.model_state, x, y)
-                    metrics = {"loss": loss, **aux}
                 else:
                     loss, aux, new_model_state, grads = _accumulated_grads(
                         loss_fn, state, x, y, accum
                     )
-                    metrics = {"loss": loss, **aux}
+            metrics = {"loss": loss, **aux}
             updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
             new_state = TrainState(
