@@ -114,6 +114,7 @@ def memory_report(
     seq_len: int | None = None,
     optimizer: str = "adamw",
     cfg_name: str = "llama",
+    grad_accum: int = 1,
 ) -> MemoryReport:
     """Per-chip HBM accounting for one (config, mesh, batch) point.
 
@@ -122,8 +123,22 @@ def memory_report(
     through the backward, plus one block's live intermediates (q/k/v/attn
     out + the SwiGLU gate/up pair) and the [B, S, V] f32 logits+grad pair.
     Batch shards over dp*fsdp, sequence over sp, heads/mlp/vocab over tp.
+
+    ``grad_accum`` models TrainerConfig.grad_accum_steps: activations
+    and logits scale with the MICROBATCH (batch/accum — only one
+    microbatch is live inside the scan), while the gradient term
+    DOUBLES (the scan carries a param-sized gradient-sum buffer in
+    addition to the microbatch gradient being produced).  Chip-validated
+    both ways (BENCH_NOTES r5): 1.1B/adafactor B=128 accum=4 trains
+    (predicted ~13.4 GiB) while 2.9B B=32 accum=4 OOMs at 20.6 G
+    (predicted ~20 GiB — the doubled gradient term is exactly what the
+    2.9B rung does not have room for).
     """
     seq_len = seq_len or cfg.max_seq_len
+    if grad_accum < 1 or batch_global % grad_accum:
+        raise ValueError(
+            f"grad_accum={grad_accum} must divide batch_global={batch_global}"
+        )
     shapes = jax.eval_shape(partial(llama.init_params, cfg), jax.random.key(0))
     specs = llama.param_specs(cfg)
     params_b = _tree_bytes(shapes, specs, mesh_axes)
@@ -132,12 +147,12 @@ def memory_report(
     else:
         n_moments = {"adamw": 2, "lamb": 2, "momentum": 1, "sgd": 0}[optimizer]
         optimizer_b = n_moments * params_b
-    gradients_b = params_b
+    gradients_b = params_b * (2 if grad_accum > 1 else 1)
 
     batch_shards = mesh_axes.get("dp", 1) * mesh_axes.get("fsdp", 1)
     seq_shards = mesh_axes.get("sp", 1)
     tp = mesh_axes.get("tp", 1)
-    b_local = max(1, batch_global // batch_shards)
+    b_local = max(1, batch_global // grad_accum // batch_shards)
     s_local = max(1, seq_len // seq_shards)
     bf16 = 2
     # Residual stream checkpointed once per layer.

@@ -131,3 +131,32 @@ def test_adafactor_memory_term_is_factored():
     # Everything except the optimizer term is identical.
     assert ada.params_gib == adamw.params_gib
     assert ada.gradients_gib == adamw.gradients_gib
+
+
+def test_grad_accum_memory_terms_match_chip_observations():
+    """The accumulation terms, bracketed by four real-chip outcomes
+    (BENCH_NOTES r5): activations/logits scale with the MICROBATCH,
+    the gradient term doubles (param-sized sum buffer).  1.1B at
+    effective batch 128 trains only under accum=4, and the 2.9B rung —
+    fitting precisely because nothing param-sized is spare — cannot
+    afford that doubled gradient buffer."""
+    from deeplearning_cfn_tpu.models.llama import LlamaConfig
+    from deeplearning_cfn_tpu.models.llama_memory import memory_report
+
+    mesh = {"dp": 1, "fsdp": 1}
+    b1 = LlamaConfig.b1(seq_len=1024)
+    b3 = LlamaConfig.b3(seq_len=1024)
+    one_shot = memory_report(b1, mesh, 128, optimizer="adafactor")
+    accum = memory_report(b1, mesh, 128, optimizer="adafactor", grad_accum=4)
+    assert not one_shot.fits("v5litepod")  # chip: OOM, 31.6 G used
+    assert accum.fits("v5litepod")  # chip: trains at MFU 0.447
+    # Activations and logits shrink with the microbatch; grads double.
+    assert accum.activations_gib < one_shot.activations_gib / 3
+    assert accum.logits_gib == one_shot.logits_gib / 4
+    assert accum.gradients_gib == 2 * one_shot.gradients_gib
+    # The top rung has no param-sized slack: accumulation cannot help.
+    top = memory_report(b3, mesh, 32, optimizer="adafactor", grad_accum=4)
+    assert not top.fits("v5litepod")  # chip: OOM, 20.6 G used
+    assert abs(top.total_gib - 20.6) < 2.0  # and the magnitude agrees
+    with pytest.raises(ValueError, match="must divide"):
+        memory_report(b1, mesh, 10, grad_accum=3)
