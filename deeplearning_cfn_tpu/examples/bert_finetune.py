@@ -81,6 +81,7 @@ def main(argv: list[str] | None = None) -> dict:
             optimizer="adamw",
             learning_rate=args.learning_rate or 3e-4,
             grad_clip_norm=1.0,
+            grad_accum_steps=args.grad_accum,
             log_every=args.log_every,
         ),
     )

@@ -94,6 +94,7 @@ def main(argv: list[str] | None = None) -> dict:
             learning_rate=args.learning_rate or 1e-4,
             weight_decay=0.01,
             grad_clip_norm=1.0,
+            grad_accum_steps=args.grad_accum,
             log_every=args.log_every,
         ),
         loss_fn=bert.mlm_loss(model),

@@ -140,6 +140,12 @@ def base_parser(description: str) -> argparse.ArgumentParser:
              "scales and biases are never decayed",
     )
     p.add_argument(
+        "--grad_accum", type=int, default=1,
+        help="microbatches per optimizer update (one compiled step scans "
+             "them, so only a single microbatch's activations are live): "
+             "fits effective batches the chip's HBM cannot hold at once",
+    )
+    p.add_argument(
         "--metrics_dir",
         default=os.environ.get("DLCFN_METRICS_DIR"),
         help="dir for structured per-worker JSONL metrics (typically the "

@@ -196,6 +196,7 @@ def main(argv: list[str] | None = None) -> dict:
             optimizer=args.optimizer,
             weight_decay=args.weight_decay or 0.0,
             grad_clip_norm=10.0,
+            grad_accum_steps=args.grad_accum,
             log_every=args.log_every,
         ),
         stateful_loss_fn=loss_fn,

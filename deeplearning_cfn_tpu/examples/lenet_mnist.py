@@ -39,6 +39,7 @@ def main(argv: list[str] | None = None) -> dict:
             # Small f32 model: pin f32 matmuls or the MXU's default bf16
             # lowering stalls training at init loss.
             matmul_precision="float32",
+            grad_accum_steps=args.grad_accum,
             log_every=args.log_every,
         ),
     )

@@ -66,11 +66,6 @@ def main(argv: list[str] | None = None) -> dict:
                    help="held-out batches for corpus perplexity after "
                         "training (0 = skip; reads the val/test split of "
                         "--data_dir when staged)")
-    p.add_argument("--grad_accum", type=int, default=1,
-                   help="microbatches per optimizer update (ONE compiled "
-                        "step scans them, so only a single microbatch's "
-                        "activations are live): fits effective batches "
-                        "the chip's HBM cannot hold at once")
     args = p.parse_args(argv)
     maybe_init_distributed()
 

@@ -102,6 +102,7 @@ def main(argv: list[str] | None = None) -> dict:
             weight_decay=args.weight_decay or 0.0,
             has_train_arg=True,
             label_smoothing=0.1,
+            grad_accum_steps=args.grad_accum,
             log_every=args.log_every,
             # uint8 records normalize inside the jitted step (fast path).
             input_stats=input_stats,
