@@ -191,11 +191,16 @@ def compile_check(
     batch_global: int,
     seq_len: int,
     compile: bool = False,
+    optimizer: str = "adamw",
+    grad_accum: int = 1,
 ) -> dict:
     """AOT-lower (optionally compile) the full train step at the given
     shapes over a virtual device mesh.  Lowering alone exercises tracing,
     sharding propagation, and shape checking; ``compile=True`` adds the
-    XLA partitioner + backend pipeline (minutes of host time at 8B)."""
+    XLA partitioner + backend pipeline (minutes of host time at 8B).
+    ``optimizer``/``grad_accum`` select the memory-lean recipe so the
+    exact program the feasibility table prices (e.g. 8B single-chip
+    adafactor + accumulation, docs/MEMORY_8B.md) is the one lowered."""
     import time
 
     from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
@@ -212,7 +217,10 @@ def compile_check(
     trainer = llama.make_trainer(
         cfg,
         mesh,
-        TrainerConfig(strategy="fsdp", optimizer="adamw", learning_rate=1e-4),
+        TrainerConfig(
+            strategy="fsdp", optimizer=optimizer, learning_rate=1e-4,
+            grad_accum_steps=grad_accum,
+        ),
     )
     tok = jax.ShapeDtypeStruct(
         (batch_global, seq_len), np.int32, sharding=trainer.batch_sharding
