@@ -140,7 +140,8 @@ def main() -> None:
                 "flops_per_step": flops_per_step,
                 "device_kind": str(getattr(devices[0], "device_kind", "unknown")),
                 "n_chips": n_chips,
-            }
+            },
+            allow_nan=False,
         )
     )
 

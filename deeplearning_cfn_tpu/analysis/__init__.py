@@ -1,0 +1,30 @@
+"""dlcfn-lint: repo-native static analysis.
+
+The reference system's characteristic failures are GLUE failures —
+drifted wire protocols, untimed network calls, silently-wrong numeric
+output — and this rebuild has the same exposure surface.  This package
+makes those bug classes mechanically checkable:
+
+- :mod:`core` — the AST rule framework (registry, per-line
+  ``# dlcfn: noqa[RULE]`` suppression, JSON/human output).
+- :mod:`rules` — the DLC0xx per-file rules (untimed blocking calls,
+  NaN-unsafe JSON, host syncs under jit, swallowed interrupts, substring
+  param matching, daemonless threads, py2 remnants, missing donation).
+- :mod:`contract_check` — the DLC1xx cross-language broker-contract
+  checker: the canonical verb set (cluster/contract.py) against the
+  Python client (broker_client.py), the supervisor (broker_service.py),
+  and the C++ handler set (native/broker/broker.cpp).
+- :mod:`runner` — file discovery + orchestration behind
+  ``python -m deeplearning_cfn_tpu.cli lint``.
+
+Rule docs: docs/STATIC_ANALYSIS.md.
+"""
+
+from deeplearning_cfn_tpu.analysis.core import (  # noqa: F401
+    FILE_RULES,
+    FileContext,
+    Rule,
+    Violation,
+    lint_source,
+)
+from deeplearning_cfn_tpu.analysis.runner import run_lint  # noqa: F401

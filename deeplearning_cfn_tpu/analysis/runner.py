@@ -1,0 +1,74 @@
+"""File discovery + orchestration for ``python -m deeplearning_cfn_tpu.cli lint``."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Iterable, Iterator
+
+# Importing rules registers them in core.FILE_RULES.
+import deeplearning_cfn_tpu.analysis.rules  # noqa: F401
+from deeplearning_cfn_tpu.analysis import contract_check
+from deeplearning_cfn_tpu.analysis.core import Violation, lint_source
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_TARGETS = ("deeplearning_cfn_tpu", "scripts", "bench.py")
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "build", "dist"}
+
+
+def discover(targets: Iterable[str | Path], root: Path = REPO_ROOT) -> Iterator[Path]:
+    for target in targets:
+        p = Path(target)
+        if not p.is_absolute():
+            p = root / p
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            yield from sorted(
+                f
+                for f in p.rglob("*.py")
+                if not any(part in _SKIP_DIRS for part in f.parts)
+            )
+
+
+def run_lint(
+    targets: Iterable[str | Path] | None = None,
+    select: set[str] | None = None,
+    root: Path = REPO_ROOT,
+    contract: bool = True,
+) -> list[Violation]:
+    """Lint the given targets (repo defaults when None).
+
+    ``select`` limits per-file rules to specific ids; the DLC1xx contract
+    checker runs unless ``contract=False`` or a ``select`` set excludes
+    both DLC100 and DLC101.
+    """
+    out: list[Violation] = []
+    for path in discover(targets if targets is not None else DEFAULT_TARGETS, root):
+        out.extend(lint_source(path, select=select))
+    run_contract = contract and (
+        select is None or select & {contract_check.RULE_VERBS, contract_check.RULE_FIELDS}
+    )
+    if run_contract:
+        contract_violations = contract_check.check_contract()
+        if select is not None:
+            contract_violations = [v for v in contract_violations if v.rule in select]
+        out.extend(contract_violations)
+    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return out
+
+
+def render_text(violations: list[Violation]) -> str:
+    lines = [v.format() for v in violations]
+    lines.append(
+        f"{len(violations)} violation(s)" if violations else "dlcfn-lint: clean"
+    )
+    return "\n".join(lines)
+
+
+def render_json(violations: list[Violation]) -> str:
+    return json.dumps(
+        {"violations": [v.to_dict() for v in violations], "count": len(violations)},
+        indent=2,
+        allow_nan=False,
+    )

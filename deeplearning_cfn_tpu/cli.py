@@ -699,6 +699,28 @@ def cmd_run(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """dlcfn-lint: the repo-native static-analysis pass (docs/STATIC_ANALYSIS.md).
+
+    Runs the DLC0xx per-file AST rules over the package + scripts and the
+    DLC1xx cross-language broker-contract checker; exit 1 on findings."""
+    from deeplearning_cfn_tpu.analysis.runner import (
+        render_json,
+        render_text,
+        run_lint,
+    )
+
+    select = None
+    if args.select:
+        select = {r.strip() for s in args.select for r in s.split(",") if r.strip()}
+    violations = run_lint(targets=args.paths or None, select=select)
+    if args.format == "json":
+        print(render_json(violations))
+    else:
+        print(render_text(violations))
+    return 1 if violations else 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="dlcfn", description=__doc__.split("\n")[0])
     sub = parser.add_subparsers(dest="command", required=True)
@@ -808,6 +830,17 @@ def main(argv: list[str] | None = None) -> int:
                     help="local HF tokenizer dir for --format text "
                          "(default: byte-level)")
     pc.set_defaults(fn=cmd_convert)
+    # lint needs no template: it analyzes the repo's own source.
+    pl = sub.add_parser("lint", help="repo-native static analysis (dlcfn-lint)")
+    pl.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the package, "
+                         "scripts/, and bench.py)")
+    pl.add_argument("--format", choices=["text", "json"], default="text")
+    pl.add_argument("--select", action="append", default=[],
+                    metavar="RULES",
+                    help="comma-separated rule ids to run (e.g. "
+                         "DLC001,DLC100); default: all")
+    pl.set_defaults(fn=cmd_lint)
     # status reads the metrics stream, no template needed.
     ps = sub.add_parser("status", help="latest per-worker training metrics")
     ps.add_argument("--metrics-dir", dest="metrics_dir", required=True,

@@ -210,7 +210,13 @@ def build_broker(force: bool = False) -> Path:
         return BROKER_BIN
     if shutil.which("make") is None or shutil.which("g++") is None:
         raise BrokerError("make/g++ not available to build the broker")
-    subprocess.run(["make", "-C", str(BROKER_DIR)], check=True, capture_output=True)
+    # Bounded: a wedged compiler must fail the provision step, not hang it.
+    subprocess.run(
+        ["make", "-C", str(BROKER_DIR)],
+        check=True,
+        capture_output=True,
+        timeout=600,
+    )
     return BROKER_BIN
 
 

@@ -30,6 +30,24 @@ COORDINATOR_HOSTNAME = "deeplearning-master"
 WORKER_HOSTNAME_FMT = "deeplearning-worker{index}"
 DEFAULT_COORDINATOR_PORT = 8476
 
+# The broker wire protocol's canonical verb set — the single source of
+# truth the cross-language contract checker (analysis/contract_check.py,
+# DLC100) enforces against broker_client.py, broker_service.py, and the
+# C++ dispatch chain in native/broker/broker.cpp.  Adding a verb to any
+# one layer without the others fails `dlcfn lint`.
+BROKER_PROTOCOL_VERBS = (
+    "AUTH",   # AUTH <token>                     authenticate the connection
+    "PING",   # PING                             liveness probe
+    "SEND",   # SEND <queue> <nbytes>\n<body>    enqueue a message
+    "RECV",   # RECV <queue> <max> <vis_ms>      lease up to max messages
+    "DEL",    # DEL <queue> <receipt>            ack a leased message
+    "DEPTH",  # DEPTH <queue>                    visible + in-flight counts
+    "PURGE",  # PURGE <queue>                    drop all messages
+    "SET",    # SET <key> <nbytes>\n<value>      kv store write
+    "GET",    # GET <key>                        kv store read
+    "UNSET",  # UNSET <key>                      kv store delete
+)
+
 
 @dataclass
 class ClusterContract:

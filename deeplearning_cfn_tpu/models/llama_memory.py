@@ -135,9 +135,15 @@ def memory_report(
     2.9B rung does not have room for).
     """
     seq_len = seq_len or cfg.max_seq_len
-    if grad_accum < 1 or batch_global % grad_accum:
+    # Two distinct failures, two distinct messages, mirroring Trainer's
+    # own validation: a zero/negative accum is a config typo, a
+    # non-dividing one is a batch-geometry problem.
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+    if batch_global % grad_accum:
         raise ValueError(
-            f"grad_accum={grad_accum} must divide batch_global={batch_global}"
+            f"batch_global={batch_global} not divisible by "
+            f"grad_accum={grad_accum}"
         )
     shapes = jax.eval_shape(partial(llama.init_params, cfg), jax.random.key(0))
     specs = llama.param_specs(cfg)

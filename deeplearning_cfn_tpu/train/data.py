@@ -318,7 +318,7 @@ class DevicePrefetcher:
                 if not put(Batch(*device_put_batch(b, sharding))):
                     return
             put(self._DONE)
-        except BaseException as e:  # surface loader errors in the consumer
+        except BaseException as e:  # dlcfn: noqa[DLC004] not swallowed: re-raised in the consumer's __iter__
             put(e)
 
     def __iter__(self) -> Iterator[Batch]:

@@ -79,6 +79,43 @@ def _longest_prefix(table: dict[str, float], kind: str) -> float | None:
     return best[1] if best is not None else None
 
 
+def utilization(
+    numerator: float | None, denominator: float | None, ndigits: int = 4
+) -> float | None:
+    """``round(numerator / denominator, ndigits)`` with None propagation.
+
+    The MFU/MBU ratio for bench emitters: either side is None when the
+    device peak is unknown (CPU/GPU test backends) or the measurement is
+    unavailable, and the honest JSON output is ``null`` — never the NaN
+    that a ``x or float('nan')`` fallback would smuggle into json.dumps
+    as an unparseable bare token.
+    """
+    if numerator is None or denominator is None or denominator == 0:
+        return None
+    value = numerator / denominator
+    if value != value or value in (float("inf"), float("-inf")):
+        return None
+    return round(value, ndigits)
+
+
+def json_safe(obj):
+    """Recursively map non-finite floats (NaN/Inf) to None so the result
+    always serializes under ``json.dumps(..., allow_nan=False)``.
+
+    Bench/metrics emitters compute ratios from measured values; a NaN
+    loss or an unknown device peak must surface as ``null`` in the
+    stream, not crash the run or emit invalid JSON."""
+    if isinstance(obj, float):
+        if obj != obj or obj in (float("inf"), float("-inf")):
+            return None
+        return obj
+    if isinstance(obj, dict):
+        return {k: json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_safe(v) for v in obj]
+    return obj
+
+
 @dataclass
 class JsonlMetricsSink:
     """Structured per-worker metrics stream on (shared) storage — the
@@ -97,9 +134,15 @@ class JsonlMetricsSink:
         self._fh = open(p, "a", buffering=1)  # line-buffered
 
     def write(self, record: dict) -> None:
+        # json_safe first: a NaN loss must land in the stream as null,
+        # not crash training (allow_nan=False alone would raise) or emit
+        # a bare NaN token nothing can parse back.
         self._fh.write(
             json.dumps(
-                {"ts": time.time(), "process": jax.process_index(), **record}
+                json_safe(
+                    {"ts": time.time(), "process": jax.process_index(), **record}
+                ),
+                allow_nan=False,
             )
             + "\n"
         )

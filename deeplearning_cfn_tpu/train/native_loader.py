@@ -40,8 +40,10 @@ class LoaderError(RuntimeError):
 
 
 def _build_library() -> None:
+    # Bounded: a wedged compiler must fail the build, not hang training.
     proc = subprocess.run(
-        ["make", "-C", str(LOADER_DIR)], capture_output=True, text=True
+        ["make", "-C", str(LOADER_DIR)], capture_output=True, text=True,
+        timeout=600,
     )
     if proc.returncode != 0:
         raise LoaderError(f"building native loader failed:\n{proc.stderr}")

@@ -102,6 +102,16 @@ class TrainerConfig:
     # contiguous chunks would leave most devices idle per microbatch.
     # Distinct from Trainer.multi_step_fn(k): that is k optimizer
     # updates per dispatch, this is one update from k part-gradients.
+    #
+    # Averaging caveat: gradients are averaged UNIFORMLY across the k
+    # microbatches (mean of per-microbatch means).  For losses normalized
+    # by a per-batch COUNT rather than the batch size — MLM loss over
+    # non-pad mask tokens, detection loss over matched boxes — that is an
+    # approximation: the exact global mean would weight each microbatch
+    # by its count.  Strided microbatch slices keep the counts near-equal
+    # in expectation, so the bias is small; it is exactly zero for
+    # fixed-denominator losses (LM next-token, classification).  See
+    # docs/BENCH_NOTES.md ("grad-accum and count-normalized losses").
     grad_accum_steps: int = 1
 
 
@@ -117,11 +127,18 @@ def decay_mask(params: Any) -> Any:
     scan-stacked parameter trees: the llama family stores per-layer norm
     scales as one [L, d] rank-2 array (models/llama.py init_params), which
     a pure rank test would decay.  So paths whose leaf name marks them as
-    norm/bias parameters are excluded at ANY rank."""
+    norm/bias parameters are excluded at ANY rank.
+
+    The name match is ANCHORED on '_'-separated components ('final_norm',
+    'attn_norm', 'bias', 'scale'), never a substring test: 'norm' in
+    'normalizer_proj' would silently exempt an unrelated projection kernel
+    from decay (DLC005)."""
+
+    _EXCLUDED = ("norm", "bias", "scale")
 
     def rule(path, p) -> bool:
         leaf = str(getattr(path[-1], "key", getattr(path[-1], "name", path[-1]))).lower()
-        if "norm" in leaf or "bias" in leaf or leaf == "scale":
+        if leaf in _EXCLUDED or leaf.rsplit("_", 1)[-1] in _EXCLUDED:
             return False
         return p.ndim > 1
 

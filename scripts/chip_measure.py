@@ -27,7 +27,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning_cfn_tpu.examples.common import enable_compile_cache
-from deeplearning_cfn_tpu.train.metrics import peak_flops_per_chip
+from deeplearning_cfn_tpu.train.metrics import (
+    json_safe,
+    peak_flops_per_chip,
+    utilization,
+)
 from deeplearning_cfn_tpu.models import llama
 from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
 from deeplearning_cfn_tpu.train.trainer import TrainerConfig
@@ -87,8 +91,7 @@ if mode == "decode":
     naive = dt_full / (REPS * new_tokens)
     step_s = max((dt_full - dt_pre) / (REPS * (new_tokens - 1)), 0.1 * naive)
     toks = batch_ * new_tokens * REPS / dt_full  # end-to-end incl. prefill
-    peak_bw = peak_hbm_bytes_per_chip() or float("nan")
-    print(json.dumps({
+    print(json.dumps(json_safe({
         "mode": "decode", "size": size, "batch": batch_,
         "prompt_len": prompt_len, "new_tokens": new_tokens,
         "param_bytes": param_bytes,
@@ -98,8 +101,10 @@ if mode == "decode":
         # at B>1 each step serves B tokens, which is what
         # tokens_per_sec aggregates.
         "ms_per_step": round(1000 * step_s, 2),
-        "mbu": round(param_bytes / step_s / peak_bw, 4),
-    }))
+        # null (not NaN) when the chip's HBM peak is unknown — the JSON
+        # stays strictly parseable on CPU/GPU test backends.
+        "mbu": utilization(param_bytes / step_s, peak_hbm_bytes_per_chip()),
+    }), allow_nan=False))
     sys.exit(0)
 
 mesh = build_mesh(MeshSpec.fsdp_parallel(len(jax.devices())))
@@ -119,8 +124,10 @@ try:
         for _ in range(2):
             state, metrics = trainer.train_step(state, tok, tgt)
         loss = float(metrics["loss"])
-        print(json.dumps({"mode": "fit", "size": size, "batch": batch,
-                          "seq": seq, "result": "FITS", "loss": round(loss, 3)}))
+        print(json.dumps(json_safe(
+            {"mode": "fit", "size": size, "batch": batch,
+             "seq": seq, "result": "FITS", "loss": round(loss, 3)}
+        ), allow_nan=False))
         sys.exit(0)
     WARM, MEAS = 3, 10
     for _ in range(WARM):
@@ -134,19 +141,22 @@ try:
     toks = batch * seq * MEAS / dt
     flops_tok = llama.train_flops_per_token(cfg, seq)
     # Device-kind dispatch, not a hardcoded v5e constant: the same
-    # harness must report honest MFU on v4/v5p chips too.
-    peak = peak_flops_per_chip(jax.devices()[0]) or float("nan")
-    mfu = flops_tok * batch * seq * MEAS / dt / peak
-    print(json.dumps({
+    # harness must report honest MFU on v4/v5p chips too — and null (not
+    # NaN) when the kind is unknown.
+    mfu = utilization(
+        flops_tok * batch * seq * MEAS / dt,
+        peak_flops_per_chip(jax.devices()[0]),
+    )
+    print(json.dumps(json_safe({
         "mode": "throughput", "size": size, "batch": batch, "seq": seq,
         "fused": fused, "optimizer": optimizer, "tokens_per_sec": round(toks, 1),
-        "ms_per_step": round(1000 * dt / MEAS, 1), "mfu": round(mfu, 4),
+        "ms_per_step": round(1000 * dt / MEAS, 1), "mfu": mfu,
         "loss": round(loss, 3),
-    }))
+    }), allow_nan=False))
 except Exception as e:
     msg = str(e)
     oom = "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg or "exceeds" in msg
     print(json.dumps({"mode": mode, "size": size, "batch": batch, "seq": seq,
                       "result": "OOM" if oom else "ERROR",
-                      "detail": msg[:300]}))
+                      "detail": msg[:300]}, allow_nan=False))
     sys.exit(2)

@@ -109,4 +109,4 @@ def measure(k: int) -> dict:
 
 if __name__ == "__main__":
     for k in (1, 2, 4):
-        print(json.dumps(measure(k)))
+        print(json.dumps(measure(k), allow_nan=False))

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# dlcfn-lint CI entry: the repo-native static-analysis pass
+# (docs/STATIC_ANALYSIS.md).  Lints the package, scripts/, and bench.py;
+# exit 1 on any finding, including broker-contract drift (DLC100/101).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m deeplearning_cfn_tpu.cli lint "$@"

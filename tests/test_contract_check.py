@@ -1,0 +1,123 @@
+"""The cross-language broker-contract checker (DLC100/DLC101).
+
+The checker's one job: a verb or message field added to any single layer
+(canonical set, Python client, supervisor, C++ broker) without the others
+must fail lint.  These tests prove both directions — the real repo passes,
+and each class of mutation (verb added to contract.py only, handler added
+to broker.cpp only, field written but never read) is caught on a mutated
+fixture copy.
+"""
+
+from pathlib import Path
+
+from deeplearning_cfn_tpu.analysis import contract_check as cc
+from deeplearning_cfn_tpu.cluster.contract import BROKER_PROTOCOL_VERBS
+
+
+def test_real_repo_layers_agree():
+    assert cc.check_contract() == []
+
+
+def test_layer_extraction_matches_canonical_set():
+    """Each extractor independently recovers the full 10-verb protocol —
+    the guarantee that an empty-extraction bug can't make agreement
+    vacuous."""
+    canon, _ = cc.canonical_verbs()
+    assert canon == set(BROKER_PROTOCOL_VERBS)
+    assert len(canon) == 10
+    assert cc.client_verbs() == canon
+    assert cc.cpp_verbs() == canon
+    # The supervisor exercises a subset (at least the liveness probe).
+    service = cc.service_verbs()
+    assert "PING" in service
+    assert service <= canon
+
+
+def _mutated(tmp_path: Path, src: Path, old: str, new: str) -> Path:
+    text = src.read_text()
+    assert old in text, f"fixture drift: {old!r} not found in {src}"
+    out = tmp_path / src.name
+    out.write_text(text.replace(old, new))
+    return out
+
+
+def test_verb_added_to_contract_without_cpp_handler_fails(tmp_path):
+    """The acceptance-criteria scenario: a new verb lands in the canonical
+    set (and nowhere else) -> lint fails naming every layer that lacks it."""
+    mutated = _mutated(
+        tmp_path, cc.CONTRACT_PY, '"UNSET",', '"UNSET",\n    "NUKE",'
+    )
+    violations = cc.check_contract(contract_py=mutated)
+    assert violations, "mutated contract must fail the check"
+    assert all(v.rule == "DLC100" for v in violations)
+    messages = "\n".join(v.message for v in violations)
+    assert "'NUKE'" in messages
+    assert "broker.cpp" in messages  # the C++ layer is called out
+    assert "broker_client" in messages  # and the Python client
+
+
+def test_handler_added_to_cpp_without_canon_fails(tmp_path):
+    mutated = _mutated(
+        tmp_path,
+        cc.BROKER_CPP,
+        'cmd == "PING"',
+        'cmd == "FROB") { /* dead */ }\n    else if (cmd == "PING"',
+    )
+    violations = cc.check_contract(broker_cpp=mutated)
+    assert [v.rule for v in violations] == ["DLC100"]
+    assert "'FROB'" in violations[0].message
+    assert "dead handler" in violations[0].message
+
+
+def test_verb_removed_from_client_fails(tmp_path):
+    """Deleting a client method's wire write leaves a canonical verb with
+    no sender."""
+    mutated = _mutated(
+        tmp_path,
+        cc.CLIENT_PY,
+        'b"PING\\n"',
+        'b"XPING\\n"',
+    )
+    violations = cc.check_contract(client_py=mutated)
+    msgs = [v.message for v in violations if v.rule == "DLC100"]
+    assert any("'PING'" in m and "Python client" in m for m in msgs)
+    # And the renamed verb is flagged as sent-but-uncanonical.
+    assert any("'XPING'" in m for m in msgs)
+
+
+def test_field_written_but_never_read_fails(tmp_path):
+    mutated = _mutated(
+        tmp_path,
+        cc.CONTRACT_PY,
+        '"tags": self.tags,',
+        '"tags": self.tags,\n            "drifted-key": 1,',
+    )
+    violations = cc.check_contract(contract_py=mutated)
+    assert [v.rule for v in violations] == ["DLC101"]
+    assert "'drifted-key'" in violations[0].message
+    assert "never reads" in violations[0].message
+
+
+def test_field_read_but_never_written_fails(tmp_path):
+    mutated = _mutated(
+        tmp_path,
+        cc.CONTRACT_PY,
+        'body.get("degraded", False)',
+        'body.get("phantom-key", False)',
+    )
+    violations = cc.check_contract(contract_py=mutated)
+    rules = {v.rule for v in violations}
+    assert rules == {"DLC101"}
+    msgs = "\n".join(v.message for v in violations)
+    # 'phantom-key' is read-but-never-written; 'degraded' becomes
+    # written-but-never-read.  Both directions fire from one drift.
+    assert "'phantom-key'" in msgs and "never writes" in msgs
+    assert "'degraded'" in msgs
+
+
+def test_envelope_fields_are_exempt():
+    """event/status are queue-side routing stamps from_message never
+    consumes — the allowlist keeps them out of DLC101."""
+    written, read = cc._message_fields()
+    assert {"event", "status"} <= written
+    assert not ({"event", "status"} & read)

@@ -1,0 +1,50 @@
+"""Tier-1-adjacent gate: the repo must lint clean.
+
+``python -m deeplearning_cfn_tpu.cli lint`` exiting 0 is an acceptance
+criterion of the static-analysis pass; this test keeps it true — any new
+violation (or broker-contract drift) fails the suite with the linter's
+own formatted findings.
+"""
+
+from deeplearning_cfn_tpu.analysis.runner import render_text, run_lint
+
+
+def test_repo_lints_clean():
+    violations = run_lint()
+    assert not violations, "\n" + render_text(violations)
+
+
+def test_cli_lint_exits_zero(capsys):
+    from deeplearning_cfn_tpu.cli import main
+
+    assert main(["lint"]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_lint_json_is_strict(capsys):
+    import json
+
+    from deeplearning_cfn_tpu.cli import main
+
+    assert main(["lint", "--format", "json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out == {"violations": [], "count": 0}
+
+
+def test_cli_lint_nonzero_on_violation(tmp_path, capsys):
+    from deeplearning_cfn_tpu.cli import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import subprocess\nsubprocess.run(['make'])\n")
+    assert main(["lint", str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "DLC001" in out and "bad.py:2" in out
+
+
+def test_cli_lint_select_limits_rules(tmp_path, capsys):
+    from deeplearning_cfn_tpu.cli import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import subprocess\nsubprocess.run(['make'])\n")
+    # Selecting an unrelated rule: the DLC001 violation is not reported.
+    assert main(["lint", "--select", "DLC007", str(bad)]) == 0

@@ -1,0 +1,279 @@
+"""dlcfn-lint rule fixtures: every DLC0xx rule fires on its seeded
+violation and stays silent on the clean repo idiom (docs/STATIC_ANALYSIS.md).
+
+Each case lints an in-memory snippet through the real
+:func:`analysis.core.lint_source` path (parse -> rules -> noqa filter), so
+these tests pin the matcher shapes AND the suppression machinery.
+"""
+
+import textwrap
+
+from deeplearning_cfn_tpu.analysis import lint_source
+
+
+def rules_for(src: str, path: str = "deeplearning_cfn_tpu/cluster/x.py"):
+    return [v.rule for v in lint_source(path, textwrap.dedent(src))]
+
+
+# --- framework: parse failure + noqa ---------------------------------------
+
+def test_syntax_error_reports_dlc000():
+    assert rules_for("def broken(:\n") == ["DLC000"]
+
+
+def test_noqa_suppresses_named_rule_only():
+    fire = "import subprocess\nsubprocess.run(['make'])\n"
+    hushed = (
+        "import subprocess\n"
+        "subprocess.run(['make'])  # dlcfn: noqa[DLC001] supervised externally\n"
+    )
+    wrong_id = (
+        "import subprocess\n"
+        "subprocess.run(['make'])  # dlcfn: noqa[DLC002] wrong rule\n"
+    )
+    assert rules_for(fire) == ["DLC001"]
+    assert rules_for(hushed) == []
+    assert rules_for(wrong_id) == ["DLC001"]
+
+
+def test_noqa_multiple_rules_on_one_line():
+    src = (
+        "import subprocess\n"
+        "subprocess.run(['make'])  # dlcfn: noqa[DLC001, DLC002] both\n"
+    )
+    assert rules_for(src) == []
+
+
+# --- DLC001: untimed blocking calls ----------------------------------------
+
+def test_dlc001_fires_on_untimed_subprocess_and_socket():
+    src = """\
+        import socket
+        import subprocess
+        subprocess.run(["make"])
+        subprocess.check_output(["ls"])
+        socket.create_connection(("host", 80))
+    """
+    assert rules_for(src) == ["DLC001"] * 3
+
+
+def test_dlc001_silent_with_timeout_kwarg_or_positional():
+    src = """\
+        import socket
+        import subprocess
+        subprocess.run(["make"], timeout=600)
+        socket.create_connection(("host", 80), 5.0)
+        connect(timeout_s=budget.remaining_s)
+    """
+    assert rules_for(src) == []
+
+
+def test_dlc001_flags_popen_wait_but_not_unrelated_wait():
+    fire = "proc.wait()\nself.process.communicate()\n"
+    clean = "self.wait()\nbarrier.wait()\nproc.wait(timeout=5)\n"
+    assert rules_for(fire) == ["DLC001"] * 2
+    assert rules_for(clean) == []
+
+
+# --- DLC002: NaN-unsafe json.dumps in bench/metrics paths ------------------
+
+def test_dlc002_fires_in_scripts_silent_when_strict():
+    fire = "import json\nprint(json.dumps({'mfu': mfu}))\n"
+    clean = "import json\nprint(json.dumps({'mfu': mfu}, allow_nan=False))\n"
+    assert rules_for(fire, "scripts/emit.py") == ["DLC002"]
+    assert rules_for(clean, "scripts/emit.py") == []
+
+
+def test_dlc002_scoped_to_bench_metrics_paths():
+    src = "import json\nprint(json.dumps({'a': 1}))\n"
+    # Non-bench modules dump JSON for configs/manifests; not in scope.
+    assert rules_for(src, "deeplearning_cfn_tpu/cluster/x.py") == []
+    assert rules_for(src, "bench.py") == ["DLC002"]
+    assert rules_for(src, "deeplearning_cfn_tpu/train/metrics.py") == ["DLC002"]
+
+
+# --- DLC003: host sync under jit -------------------------------------------
+
+def test_dlc003_fires_on_host_sync_inside_jit():
+    src = """\
+        import jax
+        @jax.jit
+        def step(x):
+            jax.device_get(x)
+            return x.item()
+    """
+    assert rules_for(src) == ["DLC003"] * 2
+
+
+def test_dlc003_partial_jit_and_np_asarray():
+    src = """\
+        import jax
+        from functools import partial
+        @partial(jax.jit, static_argnums=(1,))
+        def step(x, n):
+            return np.asarray(x)
+    """
+    assert rules_for(src) == ["DLC003"]
+
+
+def test_dlc003_silent_outside_jit_and_in_nested_defs():
+    src = """\
+        import jax
+        def log_step(x):
+            return x.item()
+        @jax.jit
+        def step(x):
+            def host_cb(y):
+                return y.item()
+            return x * 2
+    """
+    # .item() in a plain function and inside a nested (non-traced-inline)
+    # def are both out of scope for the conservative matcher.
+    assert rules_for(src) == []
+
+
+# --- DLC004: interrupt-swallowing except -----------------------------------
+
+def test_dlc004_fires_on_bare_except_and_swallowed_baseexception():
+    src = """\
+        try:
+            work()
+        except:
+            pass
+        try:
+            work()
+        except BaseException:
+            log()
+    """
+    assert rules_for(src) == ["DLC004"] * 2
+
+
+def test_dlc004_silent_when_reraised_or_exception_only():
+    src = """\
+        try:
+            work()
+        except BaseException:
+            cleanup()
+            raise
+        try:
+            work()
+        except BaseException as e:
+            cleanup()
+            raise e
+        try:
+            work()
+        except Exception:
+            log()
+    """
+    assert rules_for(src) == []
+
+
+# --- DLC005: substring param-name matching ---------------------------------
+
+def test_dlc005_fires_on_substring_leaf_match():
+    src = """\
+        def rule(leaf, p):
+            if "norm" in leaf or "bias" in leaf:
+                return False
+            return p.ndim > 1
+    """
+    assert rules_for(src) == ["DLC005"] * 2
+
+
+def test_dlc005_silent_on_anchored_or_unrelated_matching():
+    src = """\
+        def rule(leaf, p, param_name):
+            if leaf in ("norm", "bias") or leaf.rsplit("_", 1)[-1] == "norm":
+                return False
+            if param_name == "scale":
+                return False
+            return "/nodes/" in path
+    """
+    assert rules_for(src) == []
+
+
+# --- DLC006: threads without daemon/join -----------------------------------
+
+def test_dlc006_fires_without_daemon_or_join():
+    src = """\
+        import threading
+        def start():
+            t = threading.Thread(target=work)
+            t.start()
+    """
+    assert rules_for(src) == ["DLC006"]
+
+
+def test_dlc006_silent_with_daemon_or_join_path():
+    src = """\
+        import threading
+        def start_daemon():
+            threading.Thread(target=work, daemon=True).start()
+        class Pool:
+            def start(self):
+                self.t = threading.Thread(target=work)
+                self.t.start()
+            def stop(self):
+                self.t.join(timeout=5)
+    """
+    assert rules_for(src) == []
+
+
+# --- DLC007: mutable defaults + py2 remnants -------------------------------
+
+def test_dlc007_fires_on_mutable_default_and_py2():
+    src = """\
+        def f(xs=[], m={}):
+            for i in xrange(3):
+                m.has_key(i)
+    """
+    assert sorted(rules_for(src)) == ["DLC007"] * 4
+
+
+def test_dlc007_silent_on_clean_idiom():
+    src = """\
+        def f(xs=None, m=()):
+            xs = list(xs or [])
+            for i in range(3):
+                if i in m:
+                    pass
+    """
+    assert rules_for(src) == []
+
+
+# --- DLC008: undonated state-threading jit ---------------------------------
+
+def test_dlc008_fires_on_undonated_state_step():
+    src = """\
+        import jax
+        @jax.jit
+        def train_step(state, batch):
+            return state
+    """
+    assert rules_for(src) == ["DLC008"]
+
+
+def test_dlc008_call_form_with_both_shardings():
+    fire = "f = jax.jit(step, in_shardings=a, out_shardings=b)\n"
+    donated = (
+        "f = jax.jit(step, in_shardings=a, out_shardings=b,"
+        " donate_argnums=(0,))\n"
+    )
+    eval_style = "f = jax.jit(step, in_shardings=a)\n"
+    assert rules_for(fire) == ["DLC008"]
+    assert rules_for(donated) == []
+    assert rules_for(eval_style) == []
+
+
+def test_dlc008_silent_when_decorator_donates_or_not_state():
+    src = """\
+        import jax
+        from functools import partial
+        @partial(jax.jit, donate_argnums=(0,))
+        def train_step(state, batch):
+            return state
+        @jax.jit
+        def init(rng, batch):
+            return rng
+    """
+    assert rules_for(src) == []

@@ -172,5 +172,8 @@ def test_grad_accum_memory_terms_match_chip_observations():
     top = memory_report(b3, mesh, 32, optimizer="adafactor", grad_accum=4)
     assert not top.fits("v5litepod")  # chip: OOM, 20.6 G used
     assert abs(top.total_gib - 20.6) < 2.0  # and the magnitude agrees
-    with pytest.raises(ValueError, match="must divide"):
+    # Distinct messages for the two failure modes (mirroring Trainer's):
+    with pytest.raises(ValueError, match="not divisible"):
         memory_report(b1, mesh, 10, grad_accum=3)
+    with pytest.raises(ValueError, match="must be >= 1"):
+        memory_report(b1, mesh, 10, grad_accum=0)

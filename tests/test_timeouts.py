@@ -1,0 +1,118 @@
+"""utils/timeouts.py: the shared wallclock budget for multi-phase bootstrap.
+
+Previously untested (ISSUE 1 satellite).  Everything runs on FakeClock so
+the full expiry/nesting/exception choreography takes microseconds.
+"""
+
+import pytest
+
+from deeplearning_cfn_tpu.utils.timeouts import (
+    BudgetExhausted,
+    FakeClock,
+    MonotonicClock,
+    TimeoutBudget,
+)
+
+
+def test_budget_decrements_with_the_clock():
+    clock = FakeClock()
+    budget = TimeoutBudget(10.0, clock)
+    assert budget.remaining_s == 10.0
+    assert budget.elapsed_s == 0.0
+    clock.advance(4.0)
+    assert budget.remaining_s == 6.0
+    assert budget.elapsed_s == 4.0
+    budget.check("discovery")  # still funded: no raise
+
+
+def test_expiry_raises_naming_the_starved_phase():
+    clock = FakeClock()
+    budget = TimeoutBudget(5.0, clock)
+    clock.advance(5.0)
+    with pytest.raises(BudgetExhausted) as err:
+        budget.check("worker-wait")
+    assert err.value.phase == "worker-wait"
+    assert "worker-wait" in str(err.value)
+    assert "5s total" in str(err.value)
+
+
+def test_budget_exhausted_is_a_timeout_error():
+    """Callers catching TimeoutError (the stdlib contract for timeouts)
+    must see budget exhaustion too."""
+    assert issubclass(BudgetExhausted, TimeoutError)
+
+
+def test_sleep_clamps_to_remaining_and_raises_on_expiry():
+    """A 30 s poll sleep against a 7 s-remaining budget must consume
+    exactly the 7 s (not oversleep past the deadline) and then raise."""
+    clock = FakeClock()
+    budget = TimeoutBudget(7.0, clock)
+    with pytest.raises(BudgetExhausted) as err:
+        budget.sleep(30.0, phase="storage-poll")
+    assert err.value.phase == "storage-poll"
+    assert clock.now() == 7.0  # clamped: did not sleep the full 30
+
+
+def test_sleep_within_budget_advances_and_returns():
+    clock = FakeClock()
+    budget = TimeoutBudget(10.0, clock)
+    budget.sleep(3.0, phase="poll")
+    assert clock.now() == 3.0
+    assert budget.remaining_s == 7.0
+
+
+def test_nested_phases_draw_from_one_budget():
+    """The reference's discipline (setup_timeout = WAITCONDITION -
+    MASTERLAUNCH, each phase decrementing what the previous consumed): a
+    sub-phase budget carved from the parent's remaining time expires when
+    the PARENT's time is gone, even if the sub-phase just started."""
+    clock = FakeClock()
+    outer = TimeoutBudget(10.0, clock)
+    clock.advance(6.0)  # phase 1 consumed 6 s
+    inner = TimeoutBudget(outer.remaining_s, clock)
+    assert inner.remaining_s == 4.0
+    clock.advance(4.0)
+    with pytest.raises(BudgetExhausted):
+        inner.check("phase-2")
+    with pytest.raises(BudgetExhausted):
+        outer.check("phase-2")
+
+
+def test_exception_path_leaves_budget_usable():
+    """A phase failing mid-flight (the caught-and-retried path in
+    bootstrap loops) must not corrupt the budget: time keeps draining by
+    the clock, and the next phase still draws from the same pot."""
+    clock = FakeClock()
+    budget = TimeoutBudget(10.0, clock)
+    try:
+        clock.advance(2.0)
+        raise ConnectionError("broker not up yet")
+    except ConnectionError:
+        pass
+    assert budget.remaining_s == 8.0
+    budget.sleep(1.0, phase="retry-backoff")
+    assert budget.remaining_s == 7.0
+
+
+def test_remaining_goes_negative_not_clamped():
+    """remaining_s is an honest signed value; sleep() is responsible for
+    clamping, so an already-exhausted budget sleeps zero then raises."""
+    clock = FakeClock()
+    budget = TimeoutBudget(1.0, clock)
+    clock.advance(3.0)
+    assert budget.remaining_s == -2.0
+    with pytest.raises(BudgetExhausted):
+        budget.sleep(5.0, phase="late")
+    assert clock.now() == 3.0  # slept 0: nothing left to draw
+
+
+def test_monotonic_clock_is_the_default():
+    budget = TimeoutBudget(60.0)
+    assert isinstance(budget.clock, MonotonicClock)
+    assert budget.remaining_s <= 60.0
+
+
+def test_fake_clock_sleep_ignores_negative():
+    clock = FakeClock(start=5.0)
+    clock.sleep(-3.0)
+    assert clock.now() == 5.0

@@ -206,3 +206,24 @@ def test_decay_mask_excludes_stacked_norm_scales():
     assert not mask["layers"]["mlp_norm"]
     assert mask["embed"]
     assert mask["layers"]["wq"] and mask["layers"]["w_down"]
+
+
+def test_decay_mask_name_match_is_anchored_not_substring():
+    """A projection kernel whose name merely CONTAINS 'norm'/'bias' as a
+    substring ('normalizer_proj', 'biaser_w') must still decay: the old
+    `'norm' in leaf` test silently exempted such layers (DLC005).  The
+    exclusion anchors on '_'-separated components, so 'proj_norm' and
+    'out_bias' stay excluded at any rank."""
+    params = {
+        "normalizer_proj": jnp.ones((8, 8)),  # substring trap: must decay
+        "biaser_w": jnp.ones((8, 8)),         # substring trap: must decay
+        "proj_norm": jnp.ones((4, 8)),        # anchored component: excluded
+        "out_bias": jnp.ones((8,)),           # anchored component: excluded
+        "scale": jnp.ones((4, 4)),            # exact: excluded at rank 2
+    }
+    mask = decay_mask(params)
+    assert mask["normalizer_proj"]
+    assert mask["biaser_w"]
+    assert not mask["proj_norm"]
+    assert not mask["out_bias"]
+    assert not mask["scale"]
