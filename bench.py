@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning_cfn_tpu.utils.compat import set_mesh
+
 # Per-GPU throughput of the reference's flagship stack on its own hardware
 # (tensorpack ResNet-50 + Horovod on V100, the workload of README.md:149-163).
 REFERENCE_IMAGES_PER_SEC_PER_DEVICE = 350.0
@@ -93,7 +95,7 @@ def main() -> None:
 
     # Headline mode: k iterations per compiled program (see STEPS_PER_CALL).
     k = STEPS_PER_CALL
-    with jax.set_mesh(trainer.mesh):
+    with set_mesh(trainer.mesh):
         kfn = trainer.multi_step_fn(k)
         xs = jnp.broadcast_to(x, (k, *x.shape))
         ys = jnp.broadcast_to(y, (k, *y.shape))

@@ -12,7 +12,10 @@ its runbooks (StackSetup.md).  Commands:
   dlcfn plan     <template.json>                  render the launch plan
   dlcfn run      <template.json>                  provision + run the job
   dlcfn convert  --format cifar10 --src D --out O   dataset -> DLC1 records
-  dlcfn status   --metrics-dir M                  latest per-worker metrics
+  dlcfn status   [--metrics-dir M] [--cluster C | --broker H:P] [--journal J]
+                 metrics, heartbeat-driven liveness, span aggregates
+                 (--format prom for Prometheus text exposition)
+  dlcfn events   [--journal J] [-n N] [--kind K]  tail the flight journal
 
 The local backend executes everything in-process (the fake cloud); the gcp
 backend renders the equivalent TPU API calls.  ``-P`` overrides template
@@ -529,18 +532,75 @@ def cmd_stage(args) -> int:
     return 0
 
 
-def cmd_status(args) -> int:
-    """Live training status from the structured per-worker metrics stream
+def _status_liveness(args) -> dict | None:
+    """Per-worker liveness from a broker, or None when none was asked for.
+
+    ``--broker HOST:PORT`` dials directly (token from the ambient
+    $DLCFN_BROKER_TOKEN); ``--cluster NAME`` resolves the recorded broker
+    and its token from the contract root."""
+    from deeplearning_cfn_tpu.obs.liveness import LivenessConfig
+
+    if not (args.cluster or args.status_broker):
+        return None
+    config = LivenessConfig(
+        suspect_after_s=args.suspect_after, dead_after_s=args.dead_after
+    )
+    if args.status_broker:
+        from deeplearning_cfn_tpu.cluster.broker_client import (
+            BrokerConnection,
+            BrokerError,
+        )
+        from deeplearning_cfn_tpu.obs.liveness import LivenessTable
+
+        host, port = _parse_broker(args.status_broker)
+        try:
+            conn = BrokerConnection(host, port)
+        except OSError as e:
+            raise SystemExit(f"cannot reach broker at {host}:{port}: {e}") from e
+        try:
+            beats = conn.heartbeats()
+        except BrokerError as e:
+            raise SystemExit(f"heartbeat dump failed: {e}") from e
+        finally:
+            conn.close()
+        table = LivenessTable(config=config)
+        for worker, (age_s, count) in beats.items():
+            table.observe(worker, age_s=age_s, count=count)
+        table.sweep()
+        return table.snapshot()
+    from deeplearning_cfn_tpu.cluster.broker_service import cluster_liveness
+
+    return cluster_liveness(args.cluster, config=config)
+
+
+def _status_spans(args) -> dict | None:
+    """Span aggregates folded from a flight journal, or None."""
+    if not args.journal:
+        return None
+    from deeplearning_cfn_tpu.obs.recorder import read_journal
+    from deeplearning_cfn_tpu.obs.tracing import SpanStats
+
+    stats: dict[str, SpanStats] = {}
+    for event in read_journal(args.journal, kind="span"):
+        name = event.get("span")
+        seconds = event.get("seconds")
+        if not isinstance(name, str) or not isinstance(seconds, (int, float)):
+            continue
+        agg = stats.setdefault(name, SpanStats())
+        agg.fold(float(seconds), bool(event.get("ok", True)))
+    return {name: agg.as_dict() for name, agg in sorted(stats.items())}
+
+
+def _status_metrics(base: str) -> list | None:
+    """Latest per-worker train/eval records from the JSONL metrics stream
     (JsonlMetricsSink files on the shared mount) — the operator view the
     reference got by tailing per-rank mpirun logs on EFS (run.sh:82),
     machine-read instead of eyeballed."""
     import glob as _glob
 
-    base = args.metrics_dir  # argparse enforces presence (required=True)
     files = sorted(_glob.glob(str(Path(base) / "*" / "worker*.jsonl")))
     if not files:
-        print(f"no metrics under {base}", file=sys.stderr)
-        return 1
+        return None
     out = []
     for path in files:
         run = Path(path).parent.name
@@ -571,7 +631,71 @@ def cmd_status(args) -> int:
                 if k not in ("ts", "process", "event", "run")
             }
         out.append(entry)
+    return out
+
+
+def cmd_status(args) -> int:
+    """Cluster status from any of three sources (at least one required):
+    per-worker training metrics (--metrics-dir), broker-driven liveness
+    (--cluster / --broker), span aggregates from a flight journal
+    (--journal).  ``--format prom`` renders liveness + spans in Prometheus
+    text exposition for a textfile collector."""
+    if not (args.metrics_dir or args.cluster or args.status_broker or args.journal):
+        raise SystemExit(
+            "dlcfn status needs a source: --metrics-dir, --cluster, "
+            "--broker, and/or --journal"
+        )
+    liveness = _status_liveness(args)
+    spans = _status_spans(args)
+    workers = _status_metrics(args.metrics_dir) if args.metrics_dir else None
+    if args.metrics_dir and workers is None:
+        print(f"no metrics under {args.metrics_dir}", file=sys.stderr)
+        return 1
+    if args.format == "prom":
+        from deeplearning_cfn_tpu.obs.exporter import render_prometheus
+
+        print(
+            render_prometheus(
+                liveness, spans, cluster=args.cluster or ""
+            ),
+            end="",
+        )
+        return 0
+    if liveness is None and spans is None:
+        # Metrics-only: the original (round-4) output shape, unchanged.
+        print(json.dumps(workers, indent=2))
+        return 0
+    out: dict = {}
+    if liveness is not None:
+        out["liveness"] = liveness
+    if spans is not None:
+        out["spans"] = spans
+    if workers is not None:
+        out["workers"] = workers
     print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_events(args) -> int:
+    """Tail the flight journal: the last N structured events, as JSONL
+    (machine form) — the operator's replay of what the cluster did."""
+    from deeplearning_cfn_tpu.obs.recorder import ENV_JOURNAL, read_journal
+
+    path = args.journal or os.environ.get(ENV_JOURNAL)
+    if not path:
+        raise SystemExit(
+            f"dlcfn events needs --journal (or ${ENV_JOURNAL}) pointing at "
+            "a flight journal"
+        )
+    if not Path(path).exists() and not Path(path + ".1").exists():
+        print(f"no journal at {path}", file=sys.stderr)
+        return 1
+    count = 0
+    for event in read_journal(path, limit=args.last, kind=args.kind):
+        print(json.dumps(event, allow_nan=False, default=str))
+        count += 1
+    if count == 0:
+        print("journal is empty (no matching events)", file=sys.stderr)
     return 0
 
 
@@ -841,11 +965,41 @@ def main(argv: list[str] | None = None) -> int:
                     help="comma-separated rule ids to run (e.g. "
                          "DLC001,DLC100); default: all")
     pl.set_defaults(fn=cmd_lint)
-    # status reads the metrics stream, no template needed.
-    ps = sub.add_parser("status", help="latest per-worker training metrics")
-    ps.add_argument("--metrics-dir", dest="metrics_dir", required=True,
+    # status reads the metrics stream / broker / journal, no template needed.
+    ps = sub.add_parser(
+        "status", help="training metrics, worker liveness, span aggregates"
+    )
+    ps.add_argument("--metrics-dir", dest="metrics_dir", default=None,
                     help="the job's DLCFN_METRICS_DIR (shared mount)")
+    ps.add_argument("--cluster", default=None,
+                    help="cluster name: per-worker liveness from its "
+                         "recorded broker's HEARTBEAT table")
+    ps.add_argument("--broker", default=None, dest="status_broker",
+                    metavar="HOST:PORT",
+                    help="dial a broker directly for the liveness table "
+                         "(AUTH token from $DLCFN_BROKER_TOKEN)")
+    ps.add_argument("--journal", default=None,
+                    help="flight journal (JSONL) to fold span aggregates from")
+    ps.add_argument("--suspect-after", type=float, default=15.0,
+                    dest="suspect_after", metavar="S",
+                    help="heartbeat age (s) before a worker is SUSPECT")
+    ps.add_argument("--dead-after", type=float, default=60.0,
+                    dest="dead_after", metavar="S",
+                    help="heartbeat age (s) before a worker is DEAD")
+    ps.add_argument("--format", choices=["json", "prom"], default="json",
+                    help="prom = Prometheus text exposition (liveness + "
+                         "spans) for a textfile collector")
     ps.set_defaults(fn=cmd_status)
+    # events tails the flight recorder's journal.
+    pe = sub.add_parser("events", help="tail the obs flight journal")
+    pe.add_argument("--journal", default=None,
+                    help="journal path (default: $DLCFN_FLIGHT_JOURNAL)")
+    pe.add_argument("-n", "--last", type=int, default=50, dest="last",
+                    help="how many trailing events to print")
+    pe.add_argument("--kind", default=None,
+                    help="only events of this kind (e.g. span, lifecycle, "
+                         "liveness)")
+    pe.set_defaults(fn=cmd_events)
     args = parser.parse_args(argv)
     return args.fn(args)
 

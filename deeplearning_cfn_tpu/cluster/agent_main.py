@@ -50,6 +50,8 @@ from deeplearning_cfn_tpu.cluster.bootstrap import (
 )
 from deeplearning_cfn_tpu.cluster.broker_backend import BrokerAgentBackend
 from deeplearning_cfn_tpu.cluster.broker_client import BrokerError
+from deeplearning_cfn_tpu.obs.heartbeat import Heartbeater
+from deeplearning_cfn_tpu.obs.recorder import get_recorder
 from deeplearning_cfn_tpu.provision.backend import ResourceSignal
 from deeplearning_cfn_tpu.utils.logging import get_logger
 from deeplearning_cfn_tpu.utils.timeouts import BudgetExhausted, TimeoutBudget
@@ -113,6 +115,14 @@ def main() -> int:
                 log.error("broker at %s unreachable within budget", broker)
                 return 1
 
+    # Liveness: beat at the broker from the moment the control plane is
+    # reachable until the agent exits.  The supervisor's liveness watcher
+    # (broker_service.BrokerLivenessWatcher) turns sustained silence into
+    # an INSTANCE_TERMINATE — so a VM that wedges after connect is
+    # detected even though it never reports an error.
+    heartbeater = Heartbeater(host, int(port), worker_id=f"{my_group}/{index}")
+    heartbeater.start()
+
     agent = BootstrapAgent(
         backend=backend,
         cluster_name=cluster,
@@ -158,7 +168,16 @@ def main() -> int:
                 log.error("could not signal FAILURE to broker")
         return 1
     finally:
+        heartbeater.stop()
         backend.close()
+    get_recorder().record(
+        "bootstrap_complete",
+        cluster=cluster,
+        group=my_group,
+        index=index,
+        role=role,
+        workers=contract.workers_count,
+    )
     log.info(
         "bootstrap complete: %d workers, I am process %d (%s)",
         contract.workers_count,

@@ -20,9 +20,25 @@ from pathlib import Path
 from typing import Any
 
 from deeplearning_cfn_tpu.cluster.queue import Message, RendezvousQueue
+from deeplearning_cfn_tpu.obs.tracing import span
 from deeplearning_cfn_tpu.utils.logging import get_logger
 
 log = get_logger("dlcfn.broker")
+
+
+def _traced(method):
+    """Wrap an RPC method in a ``rpc.<name>`` span (obs flight journal)."""
+    import functools
+
+    span_name = f"rpc.{method.__name__}"
+
+    @functools.wraps(method)
+    def wrapper(self, *args, **kwargs):
+        with span(span_name):
+            return method(self, *args, **kwargs)
+
+    return wrapper
+
 
 BROKER_DIR = Path(__file__).resolve().parents[2] / "native" / "broker"
 BROKER_BIN = BROKER_DIR / "dlcfn-broker"
@@ -98,10 +114,12 @@ class BrokerConnection:
         out, self._buf = self._buf[:n], self._buf[n:]
         return out
 
+    @_traced
     def ping(self) -> bool:
         self.sock.sendall(b"PING\n")
         return self._read_line() == "PONG"
 
+    @_traced
     def send(self, queue: str, body: bytes) -> str:
         self.sock.sendall(f"SEND {queue} {len(body)}\n".encode() + body)
         resp = self._read_line()
@@ -109,6 +127,7 @@ class BrokerConnection:
             raise BrokerError(f"SEND failed: {resp}")
         return resp[3:]
 
+    @_traced
     def receive(self, queue: str, max_messages: int, visibility_ms: int) -> list[tuple[str, str, int, bytes]]:
         self.sock.sendall(f"RECV {queue} {max_messages} {visibility_ms}\n".encode())
         header = self._read_line()
@@ -123,10 +142,12 @@ class BrokerConnection:
             out.append((mid, receipt, int(count), self._read_exact(int(length))))
         return out
 
+    @_traced
     def delete(self, queue: str, receipt: str) -> bool:
         self.sock.sendall(f"DEL {queue} {receipt}\n".encode())
         return self._read_line() == "OK"
 
+    @_traced
     def depth(self, queue: str) -> int:
         self.sock.sendall(f"DEPTH {queue}\n".encode())
         resp = self._read_line()
@@ -134,17 +155,20 @@ class BrokerConnection:
             raise BrokerError(f"DEPTH failed: {resp}")
         return int(resp[3:])
 
+    @_traced
     def purge(self, queue: str) -> None:
         self.sock.sendall(f"PURGE {queue}\n".encode())
         if self._read_line() != "OK":
             raise BrokerError("PURGE failed")
 
     # --- shared KV (signals + group-state snapshots) ---------------------
+    @_traced
     def set(self, key: str, value: bytes) -> None:
         self.sock.sendall(f"SET {key} {len(value)}\n".encode() + value)
         if self._read_line() != "OK":
             raise BrokerError("SET failed")
 
+    @_traced
     def get(self, key: str) -> bytes | None:
         self.sock.sendall(f"GET {key}\n".encode())
         resp = self._read_line()
@@ -154,9 +178,38 @@ class BrokerConnection:
             raise BrokerError(f"GET failed: {resp}")
         return self._read_exact(int(resp[4:]))
 
+    @_traced
     def unset(self, key: str) -> bool:
         self.sock.sendall(f"UNSET {key}\n".encode())
         return self._read_line() == "OK"
+
+    # --- liveness (obs plane) --------------------------------------------
+    @_traced
+    def heartbeat(self, worker_id: str) -> int:
+        """Record a beat for ``worker_id``; returns its beat count."""
+        if not worker_id or any(c.isspace() for c in worker_id):
+            raise BrokerError(f"bad heartbeat worker id: {worker_id!r}")
+        self.sock.sendall(f"HEARTBEAT {worker_id}\n".encode())
+        resp = self._read_line()
+        if not resp.startswith("OK "):
+            raise BrokerError(f"HEARTBEAT failed: {resp}")
+        return int(resp[3:])
+
+    @_traced
+    def heartbeats(self) -> dict[str, tuple[float, int]]:
+        """Dump the broker's beat table: worker -> (age_s, beat count)."""
+        self.sock.sendall(b"HEARTBEAT\n")
+        header = self._read_line()
+        if not header.startswith("N "):
+            raise BrokerError(f"HEARTBEAT dump failed: {header}")
+        out: dict[str, tuple[float, int]] = {}
+        for _ in range(int(header[2:])):
+            hline = self._read_line().split(" ")
+            if hline[0] != "HB" or len(hline) != 4:
+                raise BrokerError(f"bad HB frame: {hline}")
+            _, worker, age_ms, count = hline
+            out[worker] = (int(age_ms) / 1000.0, int(count))
+        return out
 
 
 class BrokerQueue(RendezvousQueue):

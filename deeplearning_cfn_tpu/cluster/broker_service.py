@@ -48,6 +48,12 @@ from deeplearning_cfn_tpu.cluster.broker_client import (
     build_broker,
 )
 from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+from deeplearning_cfn_tpu.obs.liveness import (
+    LivenessConfig,
+    LivenessTable,
+    WorkerState,
+)
+from deeplearning_cfn_tpu.obs.recorder import get_recorder
 from deeplearning_cfn_tpu.utils.logging import get_logger
 
 log = get_logger("dlcfn.broker")
@@ -448,7 +454,115 @@ def ensure_broker(
         "started broker for %s at %s:%d (pid %d, log %s)",
         cluster_name, host, bound_port, proc.pid, log_path,
     )
+    get_recorder().record(
+        "broker_started",
+        cluster=cluster_name,
+        broker_host=host,
+        broker_port=bound_port,
+        broker_pid=proc.pid,
+    )
     return host, bound_port, True
+
+
+class BrokerLivenessWatcher:
+    """Polls the broker's heartbeat table and drives the liveness machine.
+
+    The supervisor-side half of the HEARTBEAT loop: agents beat at the
+    broker (obs/heartbeat.py Heartbeater); this watcher dumps the table,
+    feeds the ALIVE/SUSPECT/DEAD classifier, and publishes
+    ``INSTANCE_TERMINATE`` on the provisioner event bus for each DEAD
+    transition — silent death then takes exactly the recovery path a
+    backend-reported termination does (elasticity -> RecoveryManager).
+
+    A worker that resumes beating after DEAD is resurrected to ALIVE;
+    idempotent controllers (the bus contract) make the duplicate
+    terminate harmless if recovery already replaced it.
+    """
+
+    def __init__(
+        self,
+        cluster_name: str,
+        group: str,
+        bus=None,
+        root: Path | None = None,
+        config: LivenessConfig | None = None,
+        clock=time.monotonic,
+        fetch=None,
+    ):
+        self.cluster_name = cluster_name
+        self.group = group
+        self.bus = bus
+        self._root = root
+        self._fetch = fetch  # injectable: () -> {worker: (age_s, count)}
+        self.table = LivenessTable(
+            config=config or LivenessConfig(),
+            clock=clock,
+            on_transition=self._on_transition,
+        )
+
+    def _on_transition(self, transition) -> None:
+        worker, old, new = transition
+        log.warning(
+            "worker %s liveness: %s -> %s", worker, old.value, new.value
+        )
+        if new is WorkerState.DEAD and self.bus is not None:
+            from deeplearning_cfn_tpu.provision.events import (
+                EventKind,
+                LifecycleEvent,
+            )
+
+            self.bus.publish(
+                LifecycleEvent(
+                    kind=EventKind.INSTANCE_TERMINATE,
+                    group=self.group,
+                    instance_id=worker,
+                    detail={"reason": "heartbeat-dead", "source": "liveness"},
+                )
+            )
+
+    def _dump_heartbeats(self) -> dict[str, tuple[float, int]]:
+        if self._fetch is not None:
+            return self._fetch()
+        status = broker_status(self.cluster_name, self._root)
+        if status is None or not status["alive"]:
+            return {}
+        conn = BrokerConnection(
+            "127.0.0.1",
+            int(status["port"]),
+            timeout_s=5.0,
+            token=broker_token(self.cluster_name, self._root) or "",
+        )
+        try:
+            return conn.heartbeats()
+        finally:
+            conn.close()
+
+    def poll(self) -> list:
+        """One fetch + sweep; returns the liveness transitions."""
+        for worker, (age_s, count) in self._dump_heartbeats().items():
+            self.table.observe(worker, age_s=age_s, count=count)
+        return self.table.sweep()
+
+    def snapshot(self) -> dict:
+        return self.table.snapshot()
+
+
+def cluster_liveness(
+    cluster_name: str,
+    root: Path | None = None,
+    config: LivenessConfig | None = None,
+) -> dict:
+    """One-shot per-worker liveness for a recorded cluster broker.
+
+    The ``dlcfn status`` view: dump the broker's heartbeat table, classify
+    each worker's silence against ``config``, return the snapshot.  Empty
+    when no broker is recorded/alive or nothing has ever beaten.
+    """
+    watcher = BrokerLivenessWatcher(
+        cluster_name, group="", bus=None, root=root, config=config
+    )
+    watcher.poll()
+    return watcher.snapshot()
 
 
 def _unlink_lock_if_stale(lock: Path) -> None:
@@ -549,9 +663,11 @@ def teardown_broker(cluster_name: str, root: Path | None = None) -> dict:
     rec.unlink(missing_ok=True)
     rec.with_suffix(".log").unlink(missing_ok=True)
     _unlink_lock_if_stale(rec.with_suffix(".lock"))
-    return {
+    result = {
         "broker": "stopped" if stopped else "left-running",
         "host": status["host"],
         "port": status["port"],
         "pid": pid,
     }
+    get_recorder().record("broker_teardown", cluster=cluster_name, **result)
+    return result

@@ -46,6 +46,9 @@ BROKER_PROTOCOL_VERBS = (
     "SET",    # SET <key> <nbytes>\n<value>      kv store write
     "GET",    # GET <key>                        kv store read
     "UNSET",  # UNSET <key>                      kv store delete
+    # HEARTBEAT <worker>                         record a liveness beat
+    # HEARTBEAT                                  dump table: N <n> then HB lines
+    "HEARTBEAT",
 )
 
 

@@ -28,6 +28,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable
 
+from deeplearning_cfn_tpu.obs.recorder import get_recorder
 from deeplearning_cfn_tpu.provision.backend import Backend, InstanceState, ResourceSignal
 from deeplearning_cfn_tpu.provision.events import EventKind, LifecycleEvent
 from deeplearning_cfn_tpu.utils.logging import get_logger
@@ -105,6 +106,12 @@ class ElasticityController:
         )
         self.backend.signal_resource(policy.signal_resource, ResourceSignal.SUCCESS)
         self.backend.suspend_replace_unhealthy(policy.name)
+        get_recorder().record(
+            "group_settled",
+            group=policy.name,
+            launched=launched,
+            degraded=policy.name in self.degraded_groups,
+        )
         log.info(
             "group %s settled: launched=%d degraded=%s",
             policy.name,
@@ -152,6 +159,12 @@ class ElasticityController:
         # loss programmatically visible instead of burying it in CloudWatch.
         if event.instance_id:
             self.lost_instances.append(event.instance_id)
+        get_recorder().record(
+            "instance_lost",
+            group=policy.name,
+            instance_id=event.instance_id,
+            reason=event.detail.get("reason"),
+        )
         log.warning(
             "instance %s terminated in group %s; cluster contract is now stale — "
             "recreate the cluster (reusing storage) and resume from checkpoint",

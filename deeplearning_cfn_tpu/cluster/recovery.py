@@ -29,6 +29,8 @@ from dataclasses import dataclass, field
 from typing import Callable
 
 from deeplearning_cfn_tpu.cluster.elasticity import GroupPolicy
+from deeplearning_cfn_tpu.obs.recorder import get_recorder
+from deeplearning_cfn_tpu.obs.tracing import span
 from deeplearning_cfn_tpu.provision.events import LifecycleEvent
 from deeplearning_cfn_tpu.provision.provisioner import ProvisionResult, Provisioner
 from deeplearning_cfn_tpu.utils.logging import get_logger
@@ -69,8 +71,11 @@ class RecoveryManager:
         lost = [e.instance_id for e in self.losses]
         self.losses.clear()
         log.warning("recovering cluster after instance loss: %s", lost)
-        result = self.provisioner.recover()
+        get_recorder().record("recovery_start", lost=lost)
+        with span("recover"):
+            result = self.provisioner.recover()
         self.attach(result)
+        get_recorder().record("recovery_done", lost=lost)
         return result
 
 

@@ -28,6 +28,7 @@ import numpy as np
 
 from deeplearning_cfn_tpu.models import llama
 from deeplearning_cfn_tpu.models.llama import LlamaConfig
+from deeplearning_cfn_tpu.utils.compat import set_mesh
 
 # Usable HBM per chip (GiB).  Book values; the XLA runtime reserves a slice,
 # so budgets below 90% utilization are the deployable ones.
@@ -236,7 +237,7 @@ def compile_check(
         jax.ShapeDtypeStruct((1, seq_len), np.int32),
     )
     t0 = time.perf_counter()
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         lowered = trainer.step_fn.lower(state_shapes, tok, tok)
         out = {"lowered": True, "lower_seconds": time.perf_counter() - t0}
         if compile:

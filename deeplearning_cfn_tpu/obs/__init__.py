@@ -1,0 +1,38 @@
+"""Cluster observability plane: flight recorder, spans, liveness.
+
+The control plane (broker, elasticity, recovery, provisioner) and the
+data plane (trainer) both feed one bounded JSONL flight journal; the
+``dlcfn status`` / ``dlcfn events`` commands and the Prometheus
+exporter read it back out.  Nothing in here imports jax at module
+scope — the broker and CLI processes must stay light; the one jax
+dependency (``train.metrics.json_safe``) is imported lazily at first
+record.
+"""
+
+from deeplearning_cfn_tpu.obs.recorder import (
+    FlightRecorder,
+    configure,
+    get_recorder,
+    read_journal,
+)
+from deeplearning_cfn_tpu.obs.tracing import span, span_aggregates, reset_aggregates
+from deeplearning_cfn_tpu.obs.liveness import (
+    LivenessConfig,
+    LivenessTable,
+    WorkerState,
+)
+from deeplearning_cfn_tpu.obs.heartbeat import Heartbeater
+
+__all__ = [
+    "FlightRecorder",
+    "configure",
+    "get_recorder",
+    "read_journal",
+    "span",
+    "span_aggregates",
+    "reset_aggregates",
+    "LivenessConfig",
+    "LivenessTable",
+    "WorkerState",
+    "Heartbeater",
+]

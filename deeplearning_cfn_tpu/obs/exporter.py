@@ -1,0 +1,81 @@
+"""Prometheus text exposition for liveness and span aggregates.
+
+``dlcfn status --format prom`` renders through here; the output follows
+the text format (``# HELP`` / ``# TYPE`` then ``name{labels} value``)
+so a node-exporter textfile collector or a curl-into-pushgateway cron
+can scrape it without a client library.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+
+def _escape(value: str) -> str:
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _labels(**labels: str) -> str:
+    body = ",".join(f'{k}="{_escape(str(v))}"' for k, v in labels.items() if v != "")
+    return "{" + body + "}" if body else ""
+
+
+def render_prometheus(
+    liveness: Mapping[str, Mapping[str, Any]] | None = None,
+    spans: Mapping[str, Mapping[str, Any]] | None = None,
+    cluster: str = "",
+) -> str:
+    """Render liveness snapshot + span aggregates as Prometheus text.
+
+    ``liveness`` is ``LivenessTable.snapshot()``; ``spans`` is
+    ``tracing.span_aggregates()``.  Either may be None/empty.
+    """
+    lines: list[str] = []
+    if liveness:
+        lines += [
+            "# HELP dlcfn_worker_up 1 while the worker's heartbeat is not DEAD.",
+            "# TYPE dlcfn_worker_up gauge",
+        ]
+        for worker, row in liveness.items():
+            labels = _labels(cluster=cluster, worker=worker, state=row["state"])
+            lines.append(
+                f"dlcfn_worker_up{labels} {0 if row['state'] == 'dead' else 1}"
+            )
+        lines += [
+            "# HELP dlcfn_heartbeat_age_seconds Seconds since the worker's last heartbeat.",
+            "# TYPE dlcfn_heartbeat_age_seconds gauge",
+        ]
+        for worker, row in liveness.items():
+            labels = _labels(cluster=cluster, worker=worker)
+            lines.append(f"dlcfn_heartbeat_age_seconds{labels} {row['age_s']}")
+        lines += [
+            "# HELP dlcfn_heartbeats_total Heartbeats observed from the worker.",
+            "# TYPE dlcfn_heartbeats_total counter",
+        ]
+        for worker, row in liveness.items():
+            labels = _labels(cluster=cluster, worker=worker)
+            lines.append(f"dlcfn_heartbeats_total{labels} {row['beats']}")
+    if spans:
+        lines += [
+            "# HELP dlcfn_span_count Completed spans by name.",
+            "# TYPE dlcfn_span_count counter",
+        ]
+        for name, agg in spans.items():
+            lines.append(f"dlcfn_span_count{_labels(span=name)} {agg['count']}")
+        lines += [
+            "# HELP dlcfn_span_seconds_total Total wall seconds spent in spans.",
+            "# TYPE dlcfn_span_seconds_total counter",
+        ]
+        for name, agg in spans.items():
+            lines.append(
+                f"dlcfn_span_seconds_total{_labels(span=name)} {agg['total_s']}"
+            )
+        lines += [
+            "# HELP dlcfn_span_seconds_max Longest single span by name.",
+            "# TYPE dlcfn_span_seconds_max gauge",
+        ]
+        for name, agg in spans.items():
+            lines.append(f"dlcfn_span_seconds_max{_labels(span=name)} {agg['max_s']}")
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,107 @@
+"""Agent-side heartbeater: a daemon thread beating HEARTBEAT at the broker.
+
+Each worker agent runs one :class:`Heartbeater`; the supervisor side
+(cluster/broker_service.py BrokerLivenessWatcher) polls the broker's
+heartbeat table and drives the :mod:`~deeplearning_cfn_tpu.obs.liveness`
+state machine.  The thread owns its own connection and reconnects with
+a fresh dial on any error — a broker restart costs one missed interval,
+not a dead worker.
+
+cluster.broker_client is imported lazily: obs must stay importable
+before (and without) the cluster layer, which itself imports
+obs.tracing for RPC spans.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from deeplearning_cfn_tpu.obs.recorder import get_recorder
+from deeplearning_cfn_tpu.utils.logging import get_logger
+
+log = get_logger("dlcfn.obs")
+
+ENV_INTERVAL = "DLCFN_HEARTBEAT_S"
+DEFAULT_INTERVAL_S = 10.0
+
+
+def heartbeat_interval_s() -> float:
+    """Configured beat interval (``$DLCFN_HEARTBEAT_S``, default 10s)."""
+    raw = os.environ.get(ENV_INTERVAL, "")
+    try:
+        value = float(raw)
+    except ValueError:
+        return DEFAULT_INTERVAL_S
+    return value if value > 0 else DEFAULT_INTERVAL_S
+
+
+class Heartbeater(threading.Thread):
+    """Beats ``HEARTBEAT <worker_id>`` at the broker every interval."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        worker_id: str,
+        token: str | None = None,
+        interval_s: float | None = None,
+        connect_timeout_s: float = 10.0,
+    ):
+        # token=None -> BrokerConnection's ambient $DLCFN_BROKER_TOKEN
+        # (how agents authenticate); pass "" for an open dev broker.
+        super().__init__(name=f"heartbeater-{worker_id}", daemon=True)
+        self.host = host
+        self.port = port
+        self.worker_id = worker_id
+        self.token = token
+        self.interval_s = (
+            interval_s if interval_s is not None else heartbeat_interval_s()
+        )
+        self.connect_timeout_s = connect_timeout_s
+        self.beats_sent = 0
+        # not named _stop: threading.Thread's join internals
+        # call a private _stop() method of that name.
+        self._halt = threading.Event()
+        self._conn = None
+
+    def _beat_once(self) -> None:
+        from deeplearning_cfn_tpu.cluster.broker_client import BrokerConnection
+
+        if self._conn is None:
+            self._conn = BrokerConnection(
+                self.host,
+                self.port,
+                token=self.token,
+                timeout_s=self.connect_timeout_s,
+            )
+        self._conn.heartbeat(self.worker_id)
+        self.beats_sent += 1
+
+    def run(self) -> None:
+        get_recorder().record(
+            "heartbeater_start", worker=self.worker_id, interval_s=self.interval_s
+        )
+        while not self._halt.is_set():
+            try:
+                self._beat_once()
+            except Exception as exc:
+                # Drop the wedged connection; next loop dials fresh.
+                log.warning("heartbeat to %s:%d failed: %s", self.host, self.port, exc)
+                self._close_conn()
+            self._halt.wait(self.interval_s)
+        self._close_conn()
+
+    def _close_conn(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except Exception:
+                pass
+            self._conn = None
+
+    def stop(self, join_timeout_s: float = 5.0) -> None:
+        """Signal the loop to exit and wait (bounded) for it."""
+        self._halt.set()
+        if self.is_alive():
+            self.join(timeout=join_timeout_s)

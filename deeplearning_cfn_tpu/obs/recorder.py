@@ -1,0 +1,197 @@
+"""Flight recorder: a bounded ring journal of structured cluster events.
+
+Every control-plane actor (broker service, elasticity controller,
+recovery manager, provisioner event bus) and the trainer's span
+instrumentation write into one :class:`FlightRecorder`.  Events live in
+a fixed-size in-memory ring (cheap enough for the train-step hot path)
+and, when a journal path is configured, are appended as strict JSONL —
+one ``json.dumps(..., allow_nan=False)`` object per line, every value
+routed through ``train.metrics.json_safe`` so device arrays and numpy
+scalars degrade to plain Python before serialization.
+
+The journal file is itself bounded: after ``max_file_lines`` appends the
+file rotates to ``<path>.1`` (overwriting the previous rotation), so a
+long-running agent holds at most two generations on disk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+import weakref
+from collections import deque
+from pathlib import Path
+from typing import Any, Callable, Iterator
+
+ENV_JOURNAL = "DLCFN_FLIGHT_JOURNAL"
+
+_json_safe: Callable[[Any], Any] | None = None
+
+
+def _safe(obj: Any) -> Any:
+    """train.metrics.json_safe, imported lazily (it pulls in jax)."""
+    global _json_safe
+    if _json_safe is None:
+        from deeplearning_cfn_tpu.train.metrics import json_safe
+
+        _json_safe = json_safe
+    return _json_safe(obj)
+
+
+def _identity() -> dict[str, Any]:
+    ident: dict[str, Any] = {"host": socket.gethostname(), "pid": os.getpid()}
+    cluster = os.environ.get("DLCFN_CLUSTER")
+    if cluster:
+        ident["cluster"] = cluster
+    worker = os.environ.get("DLCFN_WORKER")
+    if worker:
+        ident["worker"] = worker
+    return ident
+
+
+class FlightRecorder:
+    """Bounded ring of structured events, optionally mirrored to JSONL."""
+
+    def __init__(
+        self,
+        path: str | Path | None = None,
+        max_events: int = 4096,
+        max_file_lines: int = 100_000,
+    ):
+        self._events: deque[dict[str, Any]] = deque(maxlen=max_events)
+        self._lock = threading.Lock()
+        self._path = Path(path) if path else None
+        self._fh = None
+        self._file_lines = 0
+        self._max_file_lines = max(1, max_file_lines)
+        self._identity = _identity()
+        self._attached_buses: "weakref.WeakSet" = weakref.WeakSet()
+        if self._path is not None:
+            self._path.parent.mkdir(parents=True, exist_ok=True)
+            self._fh = open(self._path, "a", encoding="utf-8")
+
+    @property
+    def path(self) -> Path | None:
+        return self._path
+
+    def record(self, kind: str, **fields: Any) -> dict[str, Any]:
+        """Append one event; returns the (json-safe) event dict."""
+        event: dict[str, Any] = {"ts": round(time.time(), 6), "kind": kind}
+        event.update(self._identity)
+        event.update(fields)
+        event = _safe(event)
+        with self._lock:
+            self._events.append(event)
+            if self._fh is not None:
+                # default=str: a journal must never crash its host process
+                # over an exotic detail payload — stringify, stay strict JSON.
+                line = json.dumps(event, allow_nan=False, default=str)
+                self._fh.write(line + "\n")
+                self._fh.flush()
+                self._file_lines += 1
+                if self._file_lines >= self._max_file_lines:
+                    self._rotate_locked()
+        return event
+
+    def _rotate_locked(self) -> None:
+        assert self._fh is not None and self._path is not None
+        self._fh.close()
+        os.replace(self._path, self._path.with_suffix(self._path.suffix + ".1"))
+        self._fh = open(self._path, "a", encoding="utf-8")
+        self._file_lines = 0
+
+    def tail(self, n: int = 100) -> list[dict[str, Any]]:
+        with self._lock:
+            events = list(self._events)
+        return events[-n:]
+
+    def attach_event_bus(self, bus) -> None:
+        """Mirror every provisioner lifecycle event into the journal.
+
+        Idempotent per bus: a backend shared by several provisioner
+        generations must not journal each event once per generation.
+        """
+        with self._lock:
+            if bus in self._attached_buses:
+                return
+            self._attached_buses.add(bus)
+
+        def _on_event(event) -> None:
+            self.record(
+                "lifecycle",
+                event=getattr(event.kind, "value", str(event.kind)),
+                group=event.group,
+                instance_id=event.instance_id,
+                detail=dict(event.detail),
+            )
+
+        bus.subscribe(_on_event)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+_default: FlightRecorder | None = None
+_default_lock = threading.Lock()
+
+
+def configure(
+    path: str | Path | None = None, max_events: int = 4096
+) -> FlightRecorder:
+    """Install the process-wide default recorder (closing any previous)."""
+    global _default
+    with _default_lock:
+        if _default is not None:
+            _default.close()
+        _default = FlightRecorder(path=path, max_events=max_events)
+        return _default
+
+
+def get_recorder() -> FlightRecorder:
+    """The process-wide recorder; created on first use.
+
+    Journals to ``$DLCFN_FLIGHT_JOURNAL`` when set, else in-memory only.
+    """
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = FlightRecorder(path=os.environ.get(ENV_JOURNAL) or None)
+        return _default
+
+
+def read_journal(
+    path: str | Path, limit: int | None = None, kind: str | None = None
+) -> Iterator[dict[str, Any]]:
+    """Parse a JSONL flight journal back into event dicts.
+
+    Reads ``<path>.1`` (the rotation) first when present, so the caller
+    sees one chronological stream.  A torn final line (writer died
+    mid-append) is skipped rather than raised.
+    """
+    path = Path(path)
+    events: list[dict[str, Any]] = []
+    rotated = path.with_suffix(path.suffix + ".1")
+    for part in (rotated, path):
+        if not part.exists():
+            continue
+        with open(part, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    event = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if kind is not None and event.get("kind") != kind:
+                    continue
+                events.append(event)
+    if limit is not None:
+        events = events[-limit:]
+    return iter(events)

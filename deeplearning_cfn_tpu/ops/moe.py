@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from deeplearning_cfn_tpu.utils import compat
+
 
 @dataclass(frozen=True)
 class MoEConfig:
@@ -87,7 +89,7 @@ def _n_data_groups(n_tokens: int) -> int:
     than the shard count could not be sharded evenly over (dp, fsdp) anyway,
     so if the tokens don't split evenly we fall back to one unsharded group.
     1 when no mesh context is active."""
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = compat.get_abstract_mesh()
     if mesh is None or not mesh.axis_names:
         return 1
     g = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)

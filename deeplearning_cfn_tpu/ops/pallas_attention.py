@@ -43,6 +43,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from deeplearning_cfn_tpu.utils import compat
+
+# CompilerParams is the modern (jax >= 0.6) name; 0.4.x spells the same
+# dataclass TPUCompilerParams.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 NEG_INF = -1e30
 
 # Measured on v5e (S=2048, H=8, D=64, bf16): 512x512 blocks run the
@@ -238,7 +244,7 @@ def _flash_forward(
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             # batch/head/q blocks are independent (megacore-splittable); only
             # the kv axis is sequential — it carries the VMEM accumulator.
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
@@ -441,7 +447,7 @@ def flash_attention(
         if Hkv % tp != 0:
             raise ValueError(f"tp={tp} must divide kv heads ({Hkv})")
         spec = P(("dp", "fsdp"), None, "tp", None)
-        return jax.shard_map(
+        return compat.shard_map(
             core,
             mesh=mesh,
             in_specs=(spec, spec, spec),

@@ -35,7 +35,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from deeplearning_cfn_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 

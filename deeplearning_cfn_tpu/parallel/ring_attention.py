@@ -30,7 +30,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from deeplearning_cfn_tpu.utils.compat import shard_map
 
 from deeplearning_cfn_tpu.ops.attention import _repeat_kv
 

@@ -18,6 +18,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning_cfn_tpu.utils import compat
+
 # Default logical-to-mesh rules.  Keys are logical axis names used by models;
 # values are mesh axis names (or tuples) or None (replicate).
 DEFAULT_RULES: dict[str, Any] = {
@@ -76,7 +78,7 @@ def maybe_shard(x: Any, spec: P) -> Any:
     """Apply a with_sharding_constraint hint when a mesh context is active;
     no-op otherwise.  Lets model code stay mesh-agnostic — the trainer sets
     the context mesh (trainer.train_step)."""
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = compat.get_abstract_mesh()
     if mesh is None or not mesh.axis_names:
         return x
     return jax.lax.with_sharding_constraint(x, spec)

@@ -15,6 +15,10 @@ import enum
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from deeplearning_cfn_tpu.utils.logging import get_logger
+
+log = get_logger("dlcfn.events")
+
 
 class EventKind(enum.Enum):
     # Names mirror the ASG event vocabulary the reference dispatches on
@@ -61,5 +65,18 @@ class EventBus:
             pass
 
     def publish(self, event: LifecycleEvent) -> None:
+        """Fan out to every subscriber, isolating per-handler failures.
+
+        One broken observer (a flight-recorder sink with a full disk, a
+        metrics hook) must not starve the elasticity controller of the
+        INSTANCE_TERMINATE it recovers from — SNS likewise delivers to
+        the remaining subscriptions when one endpoint errors.
+        """
         for handler in list(self._subscribers):
-            handler(event)
+            try:
+                handler(event)
+            except Exception:
+                log.exception(
+                    "event handler %r failed on %s for group %s",
+                    handler, event.kind.value, event.group,
+                )

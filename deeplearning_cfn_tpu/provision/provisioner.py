@@ -110,6 +110,11 @@ class Provisioner:
         any slow wait — the stack drivers' poll-every-30s-printing-elapsed
         behavior (mask-rcnn-stack.sh:84-92)."""
         self.backend = backend
+        # Every lifecycle event the backend fires lands in the flight
+        # journal alongside the controller's own records (obs plane).
+        from deeplearning_cfn_tpu.obs.recorder import get_recorder
+
+        get_recorder().attach_event_bus(backend.events)
         self.spec = spec.validate()
         self.contract_root = contract_root
         self.remote_agents = remote_agents

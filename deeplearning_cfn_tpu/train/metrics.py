@@ -109,6 +109,10 @@ def json_safe(obj):
         if obj != obj or obj in (float("inf"), float("-inf")):
             return None
         return obj
+    # 0-d numpy/jax scalars (np.float32 is NOT a Python float) unwrap to
+    # plain Python, then re-enter for the finiteness check.
+    if getattr(obj, "shape", None) == () and hasattr(obj, "item"):
+        return json_safe(obj.item())
     if isinstance(obj, dict):
         return {k: json_safe(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):

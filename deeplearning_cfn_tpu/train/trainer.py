@@ -49,7 +49,9 @@ from deeplearning_cfn_tpu.train.metrics import (
     ThroughputLogger,
     peak_flops_per_chip,
 )
+from deeplearning_cfn_tpu.obs.tracing import span
 from deeplearning_cfn_tpu.utils.logging import get_logger
+from deeplearning_cfn_tpu.utils.compat import set_mesh
 
 log = get_logger("dlcfn.trainer")
 
@@ -518,7 +520,7 @@ class Trainer:
     def train_step(self, state: TrainState, x: jax.Array, y: jax.Array):
         # Mesh context makes bare-PartitionSpec sharding hints inside model
         # code (e.g. llama._maybe_shard) resolvable during tracing.
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             return self.step_fn(state, x, y)
 
     # --- evaluation -------------------------------------------------------
@@ -650,11 +652,12 @@ class Trainer:
         # eval loop on device round-trips just like the old fit() did.
         per_batch: list[tuple[int, dict]] = []
         try:
-            for batch in batches:
-                x, y = device_put_batch(batch, self.batch_sharding)
-                with jax.set_mesh(self.mesh):
-                    metrics = eval_fn(state, x, y)
-                per_batch.append((len(batch.x), metrics))
+            with span("eval"):
+                for batch in batches:
+                    x, y = device_put_batch(batch, self.batch_sharding)
+                    with set_mesh(self.mesh):
+                        metrics = eval_fn(state, x, y)
+                    per_batch.append((len(batch.x), metrics))
         finally:
             if prefetcher is not None:
                 prefetcher.close()
@@ -728,10 +731,14 @@ class Trainer:
                 # every leaf leads with the batch axis, so one batch sharding
                 # applies uniformly — a single host->device transfer per batch
                 # (a no-op for already-placed prefetched batches).
-                x = jax.device_put(batch.x, self.batch_sharding)
-                y = jax.device_put(batch.y, self.batch_sharding)
-                with jax.set_mesh(self.mesh):
-                    state, metrics = step_fn(state, x, y)
+                # The span clocks HOST time: transfer + async dispatch, not
+                # device execution (docs/OBSERVABILITY.md) — a sudden jump
+                # here means the dispatch queue filled and the host blocked.
+                with span("train_step"):
+                    x = jax.device_put(batch.x, self.batch_sharding)
+                    y = jax.device_put(batch.y, self.batch_sharding)
+                    with set_mesh(self.mesh):
+                        state, metrics = step_fn(state, x, y)
                 gstep += 1
                 pending.append(metrics["loss"])
                 if i == 0:
@@ -747,7 +754,8 @@ class Trainer:
                     # steps sync-free.
                     logger.step(gstep, metrics["loss"])
                 if checkpointer is not None and checkpointer.should_save(gstep):
-                    checkpointer.save(gstep, state)
+                    with span("checkpoint", step=gstep):
+                        checkpointer.save(gstep, state)
                 if gstep % sync_every == 0 or i == steps - 1:
                     # The host blocks here anyway, so drain the pending device
                     # scalars — O(log_every) live buffers instead of O(steps).
@@ -779,10 +787,14 @@ class Trainer:
         # Same mesh context as train_step: without it, in-model sharding
         # hints are dropped and this would measure (and compile) a different
         # program than the one that runs.
-        with jax.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             lowered = self.step_fn.lower(state, x, y)
             compiled = lowered.compile()
         cost = compiled.cost_analysis() or {}
+        if isinstance(cost, (list, tuple)):
+            # jax 0.4.x returns one dict per computation; modern jax
+            # returns the main computation's dict directly.
+            cost = cost[0] if cost else {}
         out = {
             "compile_seconds": time.perf_counter() - t0,
             "cost_flops_per_step": cost.get("flops"),

@@ -1,0 +1,88 @@
+"""JAX version-compatibility shims.
+
+The repo pins jax 0.4.37 (the TPU image's toolchain) but is written
+against the modern mesh-context API: ``jax.set_mesh`` only exists from
+jax 0.6 on.  On 0.4.x the equivalent is entering the :class:`Mesh`
+itself as a context manager — semantically what every call site here
+needs (make bare ``PartitionSpec`` sharding hints resolvable during
+tracing).  One shim, used by every call site, so the version split
+lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+from typing import ContextManager
+
+import jax
+from jax.sharding import Mesh
+
+
+def set_mesh(mesh: Mesh) -> ContextManager:
+    """``with set_mesh(mesh): ...`` — the mesh context on any jax.
+
+    Prefers ``jax.set_mesh`` (jax >= 0.6, where it doubles as a context
+    manager); falls back to the ``Mesh`` context manager on older jax
+    (0.4.x), where ``with mesh:`` installs the same ambient mesh that
+    in-model ``with_sharding_constraint`` hints resolve against.
+    """
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    return mesh
+
+
+def get_abstract_mesh() -> Mesh | None:
+    """The ambient mesh context, or None when no mesh is active.
+
+    jax >= 0.6: ``jax.sharding.get_abstract_mesh()`` (an AbstractMesh —
+    empty when no context).  0.4.x: the ``with mesh:`` context lands in
+    the thread-local resource env as the physical mesh.  Both carry the
+    ``axis_names`` / ``shape`` surface the callers probe; the empty mesh
+    normalizes to None so callers get one sentinel on every version.
+    """
+    if hasattr(jax.sharding, "get_abstract_mesh"):
+        mesh = jax.sharding.get_abstract_mesh()
+        return None if mesh is None or not mesh.axis_names else mesh
+    from jax._src.mesh import thread_resources
+
+    mesh = thread_resources.env.physical_mesh
+    return None if mesh.empty else mesh
+
+
+def shard_map(
+    f,
+    *,
+    mesh: Mesh,
+    in_specs,
+    out_specs,
+    axis_names=None,
+    check_vma: bool | None = None,
+):
+    """``jax.shard_map`` with the modern keyword surface on any jax.
+
+    jax >= 0.6 exposes ``jax.shard_map(f, mesh=..., axis_names=...,
+    check_vma=...)``; 0.4.x has ``jax.experimental.shard_map.shard_map``
+    with the older spelling — ``check_rep`` instead of ``check_vma``
+    (same meaning: verify per-device values are replicated where specs
+    claim), and ``auto=`` (the *complement* of ``axis_names``: mesh axes
+    left to GSPMD instead of manual collectives).  Call sites write the
+    modern form; this shim translates down when needed.
+    """
+    if hasattr(jax, "shard_map"):
+        kwargs = {}
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    kwargs = {}
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    if axis_names is not None:
+        kwargs["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

@@ -44,30 +44,49 @@ class _ScrubFilter(logging.Filter):
         return True
 
 
-_configured: set[str] = set()
+# name -> absolute paths of file sinks already attached.  Tracking the
+# sinks (not just the name) is what lets a later get_logger(name,
+# log_file=...) ATTACH the new file instead of silently ignoring it —
+# the old early-return-on-configured bug dropped, e.g., the per-run log
+# an agent requested after import-time get_logger() calls had already
+# claimed the name.
+_configured: dict[str, set[str]] = {}
+
+
+def _add_file_sink(logger: logging.Logger, log_file: str) -> None:
+    fmt = logging.Formatter(_FORMAT)
+    fileh = logging.FileHandler(log_file)
+    fileh.setFormatter(fmt)
+    fileh.addFilter(_ScrubFilter())
+    logger.addHandler(fileh)
 
 
 def get_logger(name: str = "dlcfn", log_file: str | None = None) -> logging.Logger:
     """Return a logger writing `time level file:line msg` lines.
 
-    If ``log_file`` (or $DLCFN_LOG_FILE) is set, logs are duplicated there,
-    mirroring the reference's dual console + /var/log/dl_cfn_setup.log sink.
+    If ``log_file`` (or $DLCFN_LOG_FILE on first configuration) is set,
+    logs are duplicated there, mirroring the reference's dual console +
+    /var/log/dl_cfn_setup.log sink.  Calling again with a *different*
+    ``log_file`` attaches the new sink too (each file attaches once);
+    it never silently drops the request.
     """
     logger = logging.getLogger(name)
-    if name in _configured:
-        return logger
-    _configured.add(name)
-    logger.setLevel(os.environ.get("DLCFN_LOG_LEVEL", "INFO").upper())
-    logger.propagate = False
-    fmt = logging.Formatter(_FORMAT)
-    stream = logging.StreamHandler(sys.stderr)
-    stream.setFormatter(fmt)
-    stream.addFilter(_ScrubFilter())
-    logger.addHandler(stream)
-    log_file = log_file or os.environ.get("DLCFN_LOG_FILE")
+    sinks = _configured.get(name)
+    if sinks is None:
+        sinks = _configured[name] = set()
+        logger.setLevel(os.environ.get("DLCFN_LOG_LEVEL", "INFO").upper())
+        logger.propagate = False
+        fmt = logging.Formatter(_FORMAT)
+        stream = logging.StreamHandler(sys.stderr)
+        stream.setFormatter(fmt)
+        stream.addFilter(_ScrubFilter())
+        logger.addHandler(stream)
+        # The env fallback applies only at first configuration: it is a
+        # process-level default, not a per-call request.
+        log_file = log_file or os.environ.get("DLCFN_LOG_FILE")
     if log_file:
-        fileh = logging.FileHandler(log_file)
-        fileh.setFormatter(fmt)
-        fileh.addFilter(_ScrubFilter())
-        logger.addHandler(fileh)
+        resolved = os.path.abspath(log_file)
+        if resolved not in sinks:
+            sinks.add(resolved)
+            _add_file_sink(logger, log_file)
     return logger

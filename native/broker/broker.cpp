@@ -30,6 +30,15 @@
 //                                            WaitCondition/describe analogs
 //                                            agents read on real VMs)
 //   AUTH <token>\n                        -> OK\n | ERR bad token\n (close)
+//   HEARTBEAT <worker>\n                  -> OK <count>\n  (record a beat)
+//   HEARTBEAT\n                           -> N <n>\n then n x:
+//                                            HB <worker> <age_ms> <count>\n
+//
+// Heartbeats: the broker stores only last-beat timestamps and counts; the
+// ALIVE/SUSPECT/DEAD interpretation lives Python-side (obs/liveness.py)
+// where thresholds are configurable and clock-injectable.  Ages are
+// reported against the broker's own steady clock so the table is immune
+// to wall-clock skew between workers.
 //
 // Authentication: when the DLCFN_BROKER_TOKEN environment variable is set
 // at spawn, every verb except PING requires a successful AUTH first on the
@@ -80,9 +89,15 @@ struct Queue {
   std::map<std::string, Stored> messages;  // id -> message
 };
 
+struct Beat {
+  Clock::time_point last;
+  uint64_t count = 0;
+};
+
 std::mutex g_mu;
 std::map<std::string, Queue> g_queues;
 std::map<std::string, std::string> g_kv;
+std::map<std::string, Beat> g_beats;  // worker -> last heartbeat
 std::atomic<uint64_t> g_seq{0};
 std::atomic<uint64_t> g_id{0};
 std::string g_token;  // empty = open broker (dev/test direct spawns)
@@ -224,6 +239,32 @@ bool op_unset(const std::string& key) {
   return g_kv.erase(key) > 0;
 }
 
+uint64_t op_heartbeat(const std::string& worker) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  Beat& b = g_beats[worker];
+  b.last = Clock::now();
+  b.count++;
+  return b.count;
+}
+
+struct BeatRow {
+  std::string worker;
+  long long age_ms;
+  uint64_t count;
+};
+
+std::vector<BeatRow> op_heartbeats() {
+  std::lock_guard<std::mutex> lock(g_mu);
+  auto now = Clock::now();
+  std::vector<BeatRow> out;
+  out.reserve(g_beats.size());
+  for (const auto& [worker, b] : g_beats) {
+    auto age = std::chrono::duration_cast<std::chrono::milliseconds>(now - b.last);
+    out.push_back({worker, static_cast<long long>(age.count()), b.count});
+  }
+  return out;
+}
+
 // --- per-connection loop -------------------------------------------------
 
 void serve(int fd) {
@@ -300,6 +341,22 @@ void serve(int fd) {
       std::string key;
       ss >> key;
       if (!write_all(fd, op_unset(key) ? "OK\n" : "MISS\n")) break;
+    } else if (cmd == "HEARTBEAT") {
+      std::string worker;
+      ss >> worker;
+      if (worker.empty()) {
+        // Dump mode: the supervisor polls the whole table in one RPC.
+        auto rows = op_heartbeats();
+        std::string resp = "N " + std::to_string(rows.size()) + "\n";
+        for (auto& r : rows) {
+          resp += "HB " + r.worker + " " + std::to_string(r.age_ms) + " " +
+                  std::to_string(r.count) + "\n";
+        }
+        if (!write_all(fd, resp)) break;
+      } else {
+        uint64_t count = op_heartbeat(worker);
+        if (!write_all(fd, "OK " + std::to_string(count) + "\n")) break;
+      }
     } else if (cmd == "GET") {
       std::string key;
       ss >> key;

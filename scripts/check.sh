@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# One gate, two halves: the repo-native lint pass (dlcfn lint, including
+# the DLC100/101 broker-contract checker) then the tier-1 test suite —
+# exactly the commands ROADMAP.md designates, so CI and a developer's
+# pre-push run cannot drift apart.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== dlcfn lint =="
+python -m deeplearning_cfn_tpu.cli lint || exit 1
+
+echo "== tier-1 tests =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+  -m 'not slow' --continue-on-collection-errors \
+  -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+exit "$rc"

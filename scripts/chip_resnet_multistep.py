@@ -28,6 +28,7 @@ from deeplearning_cfn_tpu.examples.common import enable_compile_cache
 from deeplearning_cfn_tpu.models.resnet import ResNet50
 from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
 from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
+from deeplearning_cfn_tpu.utils.compat import set_mesh
 
 enable_compile_cache()
 
@@ -56,7 +57,7 @@ def measure(k: int) -> dict:
     )
     y1 = jnp.asarray(rng.integers(0, 1000, size=BATCH), jnp.int32)
     state = trainer.init(jax.random.key(0), x1)
-    with jax.set_mesh(trainer.mesh):
+    with set_mesh(trainer.mesh):
         if k == 1:
             fn = trainer.step_fn
             args = (

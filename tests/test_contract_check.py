@@ -19,12 +19,13 @@ def test_real_repo_layers_agree():
 
 
 def test_layer_extraction_matches_canonical_set():
-    """Each extractor independently recovers the full 10-verb protocol —
+    """Each extractor independently recovers the full 11-verb protocol —
     the guarantee that an empty-extraction bug can't make agreement
     vacuous."""
     canon, _ = cc.canonical_verbs()
     assert canon == set(BROKER_PROTOCOL_VERBS)
-    assert len(canon) == 10
+    assert len(canon) == 11
+    assert "HEARTBEAT" in canon  # the obs-plane liveness verb
     assert cc.client_verbs() == canon
     assert cc.cpp_verbs() == canon
     # The supervisor exercises a subset (at least the liveness probe).
@@ -83,6 +84,40 @@ def test_verb_removed_from_client_fails(tmp_path):
     assert any("'PING'" in m and "Python client" in m for m in msgs)
     # And the renamed verb is flagged as sent-but-uncanonical.
     assert any("'XPING'" in m for m in msgs)
+
+
+def test_heartbeat_removed_from_canon_fails(tmp_path):
+    """HEARTBEAT lives in all three implementation layers; dropping it
+    from the canonical set alone must flag the client and C++ senders."""
+    mutated = _mutated(tmp_path, cc.CONTRACT_PY, '    "HEARTBEAT",\n', "")
+    violations = cc.check_contract(contract_py=mutated)
+    msgs = "\n".join(v.message for v in violations)
+    assert violations and all(v.rule == "DLC100" for v in violations)
+    assert "'HEARTBEAT'" in msgs
+
+
+def test_heartbeat_handler_removed_from_cpp_fails(tmp_path):
+    mutated = _mutated(
+        tmp_path, cc.BROKER_CPP, 'cmd == "HEARTBEAT"', 'cmd == "XHEARTBEAT"'
+    )
+    violations = cc.check_contract(broker_cpp=mutated)
+    msgs = "\n".join(v.message for v in violations if v.rule == "DLC100")
+    # Canonical HEARTBEAT now lacks a C++ handler, and the mutant handler
+    # is flagged as dead — both directions from one drift.
+    assert "'HEARTBEAT'" in msgs and "broker.cpp" in msgs
+    assert "'XHEARTBEAT'" in msgs
+
+
+def test_heartbeat_removed_from_client_fails(tmp_path):
+    """Both client methods (record + dump) write the same verb token;
+    renaming both wire writes leaves HEARTBEAT with no Python sender."""
+    text = cc.CLIENT_PY.read_text()
+    mutated = tmp_path / cc.CLIENT_PY.name
+    assert text.count("HEARTBEAT") >= 2
+    mutated.write_text(text.replace('"HEARTBEAT', '"XHEARTBEAT'))
+    violations = cc.check_contract(client_py=mutated)
+    msgs = "\n".join(v.message for v in violations if v.rule == "DLC100")
+    assert "'HEARTBEAT'" in msgs and "Python client" in msgs
 
 
 def test_field_written_but_never_read_fails(tmp_path):

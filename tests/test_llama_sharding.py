@@ -30,6 +30,7 @@ import numpy as np
 from deeplearning_cfn_tpu.models import llama
 from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
 from deeplearning_cfn_tpu.train.trainer import TrainerConfig
+from deeplearning_cfn_tpu.utils.compat import set_mesh
 
 mesh = build_mesh(MeshSpec(dp=1, fsdp=2, sp=2, tp=2), jax.devices()[:8])
 cfg = llama.LlamaConfig.tiny(vocab_size=128, seq_len=16)
@@ -41,7 +42,7 @@ tokens = rng.integers(1, cfg.vocab_size, size=(4, cfg.max_seq_len), dtype=np.int
 x = jax.device_put(jnp.asarray(tokens), trainer.batch_sharding)
 y = jax.device_put(jnp.asarray(np.roll(tokens, -1, 1)), trainer.batch_sharding)
 state = trainer.init(jax.random.key(0), x)
-with jax.set_mesh(mesh):
+with set_mesh(mesh):
     trainer.step_fn.lower(state, x, y).compile()
 print("COMPILED_OK")
 """

@@ -1,0 +1,646 @@
+"""Observability plane tests: flight recorder, spans, liveness, exporter,
+heartbeats, and the acceptance loop — a worker that goes silent must march
+ALIVE → SUSPECT → DEAD and arm the recovery manager through the same
+INSTANCE_TERMINATE path a backend-reported loss takes.
+
+The reference stack had nothing here: worker death surfaced only as a
+stale IP in EC2 metadata (StackSetup.md:107-117).  These tests pin the
+replacement's contract layer by layer.
+"""
+
+import json
+import logging
+import shutil
+import time
+
+import pytest
+
+from deeplearning_cfn_tpu.obs import recorder as recorder_mod
+from deeplearning_cfn_tpu.obs.exporter import render_prometheus
+from deeplearning_cfn_tpu.obs.liveness import (
+    LivenessConfig,
+    LivenessTable,
+    WorkerState,
+)
+from deeplearning_cfn_tpu.obs.recorder import (
+    FlightRecorder,
+    configure,
+    get_recorder,
+    read_journal,
+)
+from deeplearning_cfn_tpu.obs.tracing import (
+    reset_aggregates,
+    span,
+    span_aggregates,
+)
+from deeplearning_cfn_tpu.provision.events import (
+    EventBus,
+    EventKind,
+    LifecycleEvent,
+)
+from deeplearning_cfn_tpu.utils.timeouts import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs_state():
+    """Isolate the process-global default recorder and span aggregates."""
+    saved = recorder_mod._default
+    recorder_mod._default = None
+    reset_aggregates()
+    yield
+    if recorder_mod._default is not None and recorder_mod._default is not saved:
+        recorder_mod._default.close()
+    recorder_mod._default = saved
+    reset_aggregates()
+
+
+# --- flight recorder --------------------------------------------------------
+
+
+def test_ring_is_bounded():
+    rec = FlightRecorder(max_events=4)
+    for i in range(10):
+        rec.record("tick", i=i)
+    tail = rec.tail()
+    assert len(tail) == 4
+    assert [e["i"] for e in tail] == [6, 7, 8, 9]
+    assert all(e["kind"] == "tick" for e in tail)
+
+
+def test_events_carry_identity_and_timestamp():
+    rec = FlightRecorder()
+    event = rec.record("probe")
+    assert event["kind"] == "probe"
+    assert isinstance(event["ts"], float)
+    assert event["host"] and isinstance(event["pid"], int)
+
+
+def test_journal_lines_are_strict_json(tmp_path):
+    """Every journal line must parse as one strict-JSON object — numpy
+    scalars, device arrays, and exotic payloads degrade via json_safe /
+    default=str instead of corrupting the journal."""
+    import numpy as np
+
+    path = tmp_path / "flight.jsonl"
+    rec = FlightRecorder(path=path)
+    rec.record("metrics", loss=np.float32(0.25), step=np.int64(7))
+    rec.record("weird", payload={"p": tmp_path})  # Path: default=str territory
+    rec.close()
+    lines = path.read_text().splitlines()
+    assert len(lines) == 2
+    first = json.loads(lines[0])
+    assert first["loss"] == 0.25 and first["step"] == 7
+    # Strict JSON round-trips: no NaN/Infinity tokens possible.
+    for line in lines:
+        json.loads(line)
+
+
+def test_non_finite_floats_never_reach_the_journal(tmp_path):
+    """allow_nan=False is the contract; json_safe turns the NaN into a
+    JSON-legal token (string) before dumps ever sees it."""
+    path = tmp_path / "flight.jsonl"
+    rec = FlightRecorder(path=path)
+    rec.record("bad", value=float("nan"))
+    rec.close()
+    (line,) = path.read_text().splitlines()
+    parsed = json.loads(line)  # would raise if the journal held bare NaN
+    assert "NaN" not in line.split('"value"')[0]
+    assert parsed["kind"] == "bad"
+
+
+def test_journal_rotation_bounds_disk(tmp_path):
+    path = tmp_path / "flight.jsonl"
+    rec = FlightRecorder(path=path, max_file_lines=5)
+    for i in range(12):
+        rec.record("tick", i=i)
+    rec.close()
+    rotated = tmp_path / "flight.jsonl.1"
+    assert rotated.exists()
+    # 12 appends with rotation every 5: generations hold the last <=10.
+    events = list(read_journal(path))
+    assert [e["i"] for e in events] == list(range(5, 12))
+
+
+def test_read_journal_skips_torn_tail_and_filters(tmp_path):
+    path = tmp_path / "flight.jsonl"
+    rec = FlightRecorder(path=path)
+    rec.record("span", span="step", seconds=0.1, ok=True)
+    rec.record("lifecycle", event="instance-launch")
+    rec.close()
+    with open(path, "a") as fh:
+        fh.write('{"kind": "torn-wri')  # writer died mid-append
+    assert [e["kind"] for e in read_journal(path)] == ["span", "lifecycle"]
+    assert [e["kind"] for e in read_journal(path, kind="span")] == ["span"]
+    assert list(read_journal(path, limit=1))[0]["kind"] == "lifecycle"
+
+
+def test_attach_event_bus_is_idempotent(tmp_path):
+    """A backend shared across provisioner generations must not journal
+    each lifecycle event once per generation."""
+    rec = FlightRecorder()
+    bus = EventBus()
+    rec.attach_event_bus(bus)
+    rec.attach_event_bus(bus)  # second generation, same backend
+    bus.publish(
+        LifecycleEvent(
+            kind=EventKind.INSTANCE_TERMINATE, group="g", instance_id="i-1"
+        )
+    )
+    events = [e for e in rec.tail() if e["kind"] == "lifecycle"]
+    assert len(events) == 1
+    assert events[0]["event"] == "instance-terminate"
+    assert events[0]["instance_id"] == "i-1"
+
+
+def test_configure_and_env_default(tmp_path, monkeypatch):
+    path = tmp_path / "flight.jsonl"
+    rec = configure(path=path)
+    assert get_recorder() is rec
+    rec.record("hello")
+    assert list(read_journal(path))[0]["kind"] == "hello"
+    # Fresh process default honors $DLCFN_FLIGHT_JOURNAL.
+    recorder_mod._default = None
+    env_path = tmp_path / "env.jsonl"
+    monkeypatch.setenv(recorder_mod.ENV_JOURNAL, str(env_path))
+    get_recorder().record("from-env")
+    assert list(read_journal(env_path))[0]["kind"] == "from-env"
+
+
+# --- tracing ----------------------------------------------------------------
+
+
+def test_span_folds_aggregates_and_journals():
+    rec = FlightRecorder()
+    with span("step", recorder=rec, step=3):
+        pass
+    with span("step", recorder=rec, step=4):
+        pass
+    agg = span_aggregates()["step"]
+    assert agg["count"] == 2 and agg["errors"] == 0
+    assert agg["total_s"] >= agg["max_s"] >= agg["last_s"] >= 0
+    events = [e for e in rec.tail() if e["kind"] == "span"]
+    assert [e["step"] for e in events] == [3, 4]
+    assert all(e["ok"] for e in events)
+
+
+def test_span_error_path_reraises_and_counts():
+    rec = FlightRecorder()
+    with pytest.raises(ValueError):
+        with span("boom", recorder=rec):
+            raise ValueError("no")
+    agg = span_aggregates()["boom"]
+    assert agg["count"] == 1 and agg["errors"] == 1
+    (event,) = [e for e in rec.tail() if e["kind"] == "span"]
+    assert event["ok"] is False
+    reset_aggregates()
+    assert span_aggregates() == {}
+
+
+# --- liveness state machine -------------------------------------------------
+
+
+def test_liveness_config_validates():
+    with pytest.raises(ValueError):
+        LivenessConfig(suspect_after_s=10.0, dead_after_s=5.0)
+    with pytest.raises(ValueError):
+        LivenessConfig(suspect_after_s=0.0)
+    cfg = LivenessConfig(suspect_after_s=1.0, dead_after_s=2.0)
+    assert cfg.classify(0.5) is WorkerState.ALIVE
+    assert cfg.classify(1.0) is WorkerState.SUSPECT
+    assert cfg.classify(2.0) is WorkerState.DEAD
+
+
+def test_alive_suspect_dead_and_resurrection():
+    clock = FakeClock()
+    transitions = []
+    rec = FlightRecorder()
+    table = LivenessTable(
+        config=LivenessConfig(suspect_after_s=10.0, dead_after_s=30.0),
+        clock=clock.now,
+        on_transition=transitions.append,
+        recorder=rec,
+    )
+    table.beat("w0")
+    assert table.sweep() == []
+    assert table.state("w0") is WorkerState.ALIVE
+
+    clock.advance(15.0)
+    assert table.sweep() == [("w0", WorkerState.ALIVE, WorkerState.SUSPECT)]
+    clock.advance(20.0)  # total silence 35s
+    assert table.sweep() == [("w0", WorkerState.SUSPECT, WorkerState.DEAD)]
+    assert table.sweep() == []  # no re-fire while still dead
+
+    table.beat("w0")  # partition healed: the worker beats again
+    assert table.sweep() == [("w0", WorkerState.DEAD, WorkerState.ALIVE)]
+    assert len(transitions) == 3
+    journaled = [e for e in rec.tail() if e["kind"] == "liveness"]
+    assert [(e["from_state"], e["to_state"]) for e in journaled] == [
+        ("alive", "suspect"),
+        ("suspect", "dead"),
+        ("dead", "alive"),
+    ]
+
+
+def test_observe_backdates_but_never_rewinds():
+    clock = FakeClock()
+    table = LivenessTable(
+        config=LivenessConfig(suspect_after_s=10.0, dead_after_s=30.0),
+        clock=clock.now,
+        recorder=FlightRecorder(),
+    )
+    table.observe("w0", age_s=12.0, count=5)  # broker-reported age
+    table.sweep()
+    assert table.state("w0") is WorkerState.SUSPECT
+    # A second poll reporting an OLDER beat must not rewind last_beat.
+    table.observe("w0", age_s=40.0, count=5)
+    table.sweep()
+    assert table.state("w0") is WorkerState.SUSPECT
+    snap = table.snapshot()["w0"]
+    assert snap["beats"] == 5 and snap["state"] == "suspect"
+
+
+def test_expect_marches_a_never_beating_worker_to_dead():
+    clock = FakeClock()
+    table = LivenessTable(
+        config=LivenessConfig(suspect_after_s=10.0, dead_after_s=30.0),
+        clock=clock.now,
+        recorder=FlightRecorder(),
+    )
+    table.expect("ghost")
+    clock.advance(31.0)
+    transitions = table.sweep()
+    assert ("ghost", WorkerState.ALIVE, WorkerState.DEAD) in transitions
+
+
+# --- exporter ---------------------------------------------------------------
+
+
+def test_render_prometheus():
+    liveness = {
+        "g/0": {"state": "alive", "age_s": 0.5, "beats": 42},
+        "g/1": {"state": "dead", "age_s": 99.0, "beats": 7},
+    }
+    spans = {"train_step": {"count": 10, "errors": 0, "total_s": 1.5,
+                            "max_s": 0.3, "last_s": 0.1}}
+    text = render_prometheus(liveness, spans, cluster="c1")
+    assert text.endswith("\n")
+    assert 'dlcfn_worker_up{cluster="c1",worker="g/0",state="alive"} 1' in text
+    assert 'dlcfn_worker_up{cluster="c1",worker="g/1",state="dead"} 0' in text
+    assert 'dlcfn_heartbeats_total{cluster="c1",worker="g/0"} 42' in text
+    assert 'dlcfn_span_count{span="train_step"} 10' in text
+    assert 'dlcfn_span_seconds_total{span="train_step"} 1.5' in text
+    assert render_prometheus(None, None) == ""
+
+
+def test_render_prometheus_escapes_labels():
+    text = render_prometheus({'w"0\n': {"state": "alive", "age_s": 0, "beats": 1}})
+    assert 'worker="w\\"0\\n"' in text
+
+
+# --- event bus isolation (satellite) ----------------------------------------
+
+
+class _ListHandler(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+def test_event_bus_isolates_handler_failures():
+    """One broken observer must not starve the controller of its event."""
+    bus = EventBus()
+    seen = []
+
+    def broken(event):
+        raise RuntimeError("full disk")
+
+    bus.subscribe(broken)
+    bus.subscribe(seen.append)
+    # dlcfn loggers don't propagate; hook the events logger directly.
+    collector = _ListHandler()
+    events_log = logging.getLogger("dlcfn.events")
+    events_log.addHandler(collector)
+    try:
+        bus.publish(LifecycleEvent(kind=EventKind.INSTANCE_TERMINATE, group="g"))
+    finally:
+        events_log.removeHandler(collector)
+    assert len(seen) == 1  # the healthy subscriber still got it
+    assert any(
+        "failed on instance-terminate" in r.getMessage() for r in collector.records
+    )
+
+
+# --- get_logger log_file regression (satellite) -----------------------------
+
+
+def test_get_logger_attaches_file_on_later_call(tmp_path):
+    from deeplearning_cfn_tpu.utils.logging import get_logger
+
+    name = "dlcfn.test-late-sink"
+    first = get_logger(name)  # import-time style call claims the name
+    late_file = tmp_path / "late.log"
+    second = get_logger(name, log_file=str(late_file))
+    assert first is second
+    second.info("hello-late-sink")
+    for handler in second.handlers:
+        handler.flush()
+    assert "hello-late-sink" in late_file.read_text()
+    # Same file again must not double-attach (no duplicate lines).
+    get_logger(name, log_file=str(late_file)).info("once-only")
+    for handler in second.handlers:
+        handler.flush()
+    assert late_file.read_text().count("once-only") == 1
+
+
+# --- heartbeat loop against the native broker (acceptance) ------------------
+
+native = pytest.mark.skipif(
+    shutil.which("g++") is None or shutil.which("make") is None,
+    reason="native toolchain unavailable",
+)
+
+
+def _wait_until(predicate, timeout_s=5.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+@native
+def test_heartbeat_verb_roundtrip():
+    from deeplearning_cfn_tpu.cluster.broker_client import (
+        BrokerConnection,
+        BrokerProcess,
+    )
+
+    with BrokerProcess() as broker:
+        conn = BrokerConnection("127.0.0.1", broker.port, token="")
+        try:
+            assert conn.heartbeat("g/0") == 1
+            assert conn.heartbeat("g/0") == 2
+            assert conn.heartbeat("g/1") == 1
+            beats = conn.heartbeats()
+        finally:
+            conn.close()
+    assert set(beats) == {"g/0", "g/1"}
+    age_s, count = beats["g/0"]
+    assert count == 2 and 0 <= age_s < 5.0
+
+
+@native
+def test_heartbeat_requires_auth_when_broker_is_tokened():
+    from deeplearning_cfn_tpu.cluster.broker_client import (
+        BrokerConnection,
+        BrokerError,
+        BrokerProcess,
+    )
+
+    with BrokerProcess(token="s3cret") as broker:
+        conn = BrokerConnection("127.0.0.1", broker.port, token="")
+        try:
+            with pytest.raises(BrokerError):
+                conn.heartbeat("g/0")
+        finally:
+            conn.close()
+        conn = BrokerConnection("127.0.0.1", broker.port, token="s3cret")
+        try:
+            assert conn.heartbeat("g/0") == 1
+        finally:
+            conn.close()
+
+
+@native
+def test_heartbeater_thread_beats_and_stops():
+    from deeplearning_cfn_tpu.cluster.broker_client import (
+        BrokerConnection,
+        BrokerProcess,
+    )
+    from deeplearning_cfn_tpu.obs.heartbeat import Heartbeater
+
+    with BrokerProcess() as broker:
+        hb = Heartbeater(
+            "127.0.0.1", broker.port, worker_id="g/0", token="", interval_s=0.05
+        )
+        hb.start()
+        assert _wait_until(lambda: hb.beats_sent >= 3)
+        hb.stop()
+        assert not hb.is_alive()
+        sent = hb.beats_sent
+        conn = BrokerConnection("127.0.0.1", broker.port, token="")
+        try:
+            _, count = conn.heartbeats()["g/0"]
+        finally:
+            conn.close()
+        assert count >= 3
+        time.sleep(0.15)
+        assert hb.beats_sent == sent  # stopped means stopped
+
+
+@native
+def test_silent_death_arms_recovery(contract_root):
+    """The acceptance loop: a worker's heartbeats stop; the liveness
+    watcher walks it ALIVE → SUSPECT → DEAD and publishes
+    INSTANCE_TERMINATE on the provisioner bus; the elasticity controller
+    routes it to RecoveryManager exactly like a backend-reported loss."""
+    from deeplearning_cfn_tpu.cluster.broker_client import BrokerProcess
+    from deeplearning_cfn_tpu.cluster.broker_service import BrokerLivenessWatcher
+    from deeplearning_cfn_tpu.cluster.recovery import RecoveryManager
+    from deeplearning_cfn_tpu.config.schema import (
+        ClusterSpec,
+        JobSpec,
+        NodePool,
+        StorageSpec,
+    )
+    from deeplearning_cfn_tpu.obs.heartbeat import Heartbeater
+    from deeplearning_cfn_tpu.provision.local import LocalBackend
+    from deeplearning_cfn_tpu.provision.provisioner import (
+        Provisioner,
+        worker_group_name,
+    )
+
+    spec = ClusterSpec(
+        name="obs-accept",
+        backend="local",
+        pool=NodePool(accelerator_type="local-1", workers=2),
+        storage=StorageSpec(kind="local"),
+        job=JobSpec(global_batch_size=16),
+    )
+    group = worker_group_name("obs-accept")
+    backend = LocalBackend(clock=FakeClock())
+    prov = Provisioner(backend, spec, contract_root=contract_root)
+    result = prov.provision()
+    manager = RecoveryManager(prov)
+    manager.attach(result)
+    assert not manager.needs_recovery
+
+    with BrokerProcess() as broker:
+        watcher = BrokerLivenessWatcher(
+            "obs-accept",
+            group=group,
+            bus=backend.events,
+            config=LivenessConfig(suspect_after_s=0.2, dead_after_s=0.5),
+            fetch=lambda: _dump(broker),
+        )
+        hb = Heartbeater(
+            "127.0.0.1", broker.port, worker_id=f"{group}/0", token="",
+            interval_s=0.05,
+        )
+        hb.start()
+        assert _wait_until(lambda: hb.beats_sent >= 2)
+        watcher.poll()
+        assert watcher.table.state(f"{group}/0") is WorkerState.ALIVE
+
+        hb.stop()  # the worker goes silent — no error is ever reported
+        states = set()
+        assert _wait_until(
+            lambda: (
+                watcher.poll(),
+                states.add(watcher.table.state(f"{group}/0")),
+                watcher.table.state(f"{group}/0") is WorkerState.DEAD,
+            )[-1],
+            timeout_s=10.0,
+            interval_s=0.05,
+        )
+        assert WorkerState.SUSPECT in states  # it marched, not jumped
+
+    assert manager.needs_recovery
+    assert manager.losses[0].instance_id == f"{group}/0"
+    assert manager.losses[0].detail["reason"] == "heartbeat-dead"
+    recovered = manager.recover()
+    assert recovered.contract.workers_count == 2
+    assert not manager.needs_recovery
+
+
+def _dump(broker):
+    from deeplearning_cfn_tpu.cluster.broker_client import BrokerConnection
+
+    conn = BrokerConnection("127.0.0.1", broker.port, token="")
+    try:
+        return conn.heartbeats()
+    finally:
+        conn.close()
+
+
+def test_watcher_fetch_injection_no_broker_needed():
+    """The watcher's state machine is testable without any broker: inject
+    fetch + clock and drive silence deterministically."""
+    from deeplearning_cfn_tpu.cluster.broker_service import BrokerLivenessWatcher
+
+    clock = FakeClock()
+    ages = {"g/0": (0.0, 1)}
+    bus = EventBus()
+    dead_events = []
+    bus.subscribe(
+        lambda e: dead_events.append(e)
+        if e.kind is EventKind.INSTANCE_TERMINATE
+        else None
+    )
+    watcher = BrokerLivenessWatcher(
+        "c",
+        group="g",
+        bus=bus,
+        config=LivenessConfig(suspect_after_s=10.0, dead_after_s=30.0),
+        clock=clock.now,
+        fetch=lambda: dict(ages),
+    )
+    watcher.poll()
+    assert watcher.snapshot()["g/0"]["state"] == "alive"
+    ages["g/0"] = (35.0, 1)  # broker now reports 35s of silence
+    clock.advance(35.0)
+    transitions = watcher.poll()
+    assert ("g/0", WorkerState.ALIVE, WorkerState.DEAD) in transitions
+    assert len(dead_events) == 1
+    assert dead_events[0].group == "g"
+    assert dead_events[0].detail["source"] == "liveness"
+
+
+# --- CLI surface ------------------------------------------------------------
+
+
+def test_cli_events_reads_journal(tmp_path, capsys):
+    from deeplearning_cfn_tpu.cli import main
+
+    path = tmp_path / "flight.jsonl"
+    rec = FlightRecorder(path=path)
+    rec.record("span", span="step", seconds=0.1, ok=True)
+    rec.record("lifecycle", event="instance-launch")
+    rec.close()
+    assert main(["events", "--journal", str(path)]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert [json.loads(line)["kind"] for line in lines] == ["span", "lifecycle"]
+    assert main(["events", "--journal", str(path), "--kind", "span", "-n", "1"]) == 0
+    (line,) = capsys.readouterr().out.strip().splitlines()
+    assert json.loads(line)["span"] == "step"
+
+
+def test_cli_events_missing_journal(tmp_path, capsys):
+    from deeplearning_cfn_tpu.cli import main
+
+    assert main(["events", "--journal", str(tmp_path / "nope.jsonl")]) == 1
+    with pytest.raises(SystemExit, match="needs --journal"):
+        main(["events"])
+
+
+def test_cli_status_requires_a_source():
+    from deeplearning_cfn_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="needs a source"):
+        main(["status"])
+
+
+def test_cli_status_spans_from_journal(tmp_path, capsys):
+    from deeplearning_cfn_tpu.cli import main
+
+    path = tmp_path / "flight.jsonl"
+    rec = FlightRecorder(path=path)
+    with span("step", recorder=rec):
+        pass
+    with pytest.raises(RuntimeError):
+        with span("step", recorder=rec):
+            raise RuntimeError("x")
+    rec.close()
+    assert main(["status", "--journal", str(path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["spans"]["step"]["count"] == 2
+    assert out["spans"]["step"]["errors"] == 1
+
+
+@native
+def test_cli_status_broker_liveness_and_prom(tmp_path, capsys, monkeypatch):
+    from deeplearning_cfn_tpu.cli import main
+    from deeplearning_cfn_tpu.cluster.broker_client import (
+        BrokerConnection,
+        BrokerProcess,
+    )
+
+    monkeypatch.delenv("DLCFN_BROKER_TOKEN", raising=False)
+    with BrokerProcess() as broker:
+        conn = BrokerConnection("127.0.0.1", broker.port, token="")
+        try:
+            conn.heartbeat("g/0")
+        finally:
+            conn.close()
+        target = f"127.0.0.1:{broker.port}"
+        assert main(["status", "--broker", target]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["liveness"]["g/0"]["state"] == "alive"
+        assert out["liveness"]["g/0"]["beats"] == 1
+
+        path = tmp_path / "flight.jsonl"
+        rec = FlightRecorder(path=path)
+        with span("train_step", recorder=rec):
+            pass
+        rec.close()
+        assert main(
+            ["status", "--broker", target, "--journal", str(path),
+             "--format", "prom"]
+        ) == 0
+        text = capsys.readouterr().out
+        assert 'dlcfn_worker_up{worker="g/0",state="alive"} 1' in text
+        assert 'dlcfn_span_count{span="train_step"} 1' in text

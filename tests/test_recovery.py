@@ -228,3 +228,44 @@ def test_recovery_resumes_data_stream_not_replay(contract_root, tmp_path):
     trained = episodes[0]["consumed"][1:] + episodes[1]["consumed"][1:]
     assert trained == straight[1:11]
     assert len(set(straight)) == len(straight)
+
+
+def test_duplicate_terminate_events_recover_once(contract_root):
+    """At-least-once bus delivery (the SNS/SQS redelivery analog): one
+    kill delivered twice must still mean one recovery.  The manager may
+    record both deliveries, but recover() drains them in one pass and
+    the recreated cluster is whole."""
+    backend = LocalBackend(clock=FakeClock(), duplicate_events=True)
+    prov = Provisioner(backend, make_spec(), contract_root=contract_root)
+    result = prov.provision()
+    manager = RecoveryManager(prov)
+    manager.attach(result)
+    victim = backend.describe_group(GROUP).instances[1]
+    backend.kill_instance(victim.instance_id)
+    assert manager.needs_recovery
+    # Both deliveries observed — all for the same single victim.
+    assert {e.instance_id for e in manager.losses} == {victim.instance_id}
+    assert set(result.controller.lost_instances) == {victim.instance_id}
+    recovered = manager.recover()
+    assert recovered.contract.workers_count == 4
+    assert not manager.needs_recovery
+
+
+def test_run_with_recovery_gives_up_past_max(contract_root):
+    """A cluster that loses an instance every episode must not loop
+    forever: past max_recoveries the loop raises, naming the pending
+    losses."""
+    import pytest
+
+    backend = LocalBackend(clock=FakeClock())
+    prov = Provisioner(backend, make_spec(), contract_root=contract_root)
+
+    def train_once(result):
+        coord = min(
+            backend.describe_group(GROUP).instances, key=lambda i: i.index
+        )
+        backend.kill_instance(coord.instance_id)
+        return {"ok": True}
+
+    with pytest.raises(RuntimeError, match="giving up"):
+        run_with_recovery(prov, train_once, max_recoveries=1)

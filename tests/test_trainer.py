@@ -15,6 +15,7 @@ from deeplearning_cfn_tpu.models.lenet import LeNet
 from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
 from deeplearning_cfn_tpu.parallel.sharding import infer_param_sharding
 from deeplearning_cfn_tpu.train.data import SyntheticDataset
+from deeplearning_cfn_tpu.utils.compat import set_mesh
 from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
 
 
@@ -143,9 +144,11 @@ def test_evaluate_aggregates_weighted_metrics():
         TrainerConfig(learning_rate=0.05, matmul_precision="float32"),
     )
     ds = SyntheticDataset(shape=(8, 8, 1), num_classes=4, batch_size=16)
-    batches = list(ds.batches(20))
+    # 60 steps: enough for LeNet to clear the chance bar by a wide margin
+    # under jax 0.4.x numerics (20 steps lands within noise of 0.25).
+    batches = list(ds.batches(60))
     state = trainer.init(jax.random.key(0), jnp.asarray(batches[0].x))
-    state, _ = trainer.fit(state, iter(batches), steps=20)
+    state, _ = trainer.fit(state, iter(batches), steps=60)
 
     # Same task (template_seed=0 matches training templates), fresh
     # sample stream.
@@ -444,7 +447,7 @@ def test_multi_step_fn_matches_sequential_steps():
 
     t2 = make()
     s2 = t2.init(jax.random.key(0), jnp.asarray(batches[0].x))
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         s2, losses = t2.multi_step_fn(4)(s2, jnp.asarray(xs), jnp.asarray(ys))
     np.testing.assert_allclose(
         np.asarray(losses), np.asarray(losses_seq), rtol=1e-5
