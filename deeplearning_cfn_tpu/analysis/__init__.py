@@ -14,8 +14,22 @@ makes those bug classes mechanically checkable:
   checker: the canonical verb set (cluster/contract.py) against the
   Python client (broker_client.py), the supervisor (broker_service.py),
   and the C++ handler set (native/broker/broker.cpp).
-- :mod:`runner` — file discovery + orchestration behind
-  ``python -m deeplearning_cfn_tpu.cli lint``.
+- :mod:`concurrency` — the DLC2xx lockset/thread-escape rules
+  (unlocked cross-thread attribute writes, bare ``acquire()``, blocking
+  I/O under a lock, unstoppable daemon threads, wall-clock liveness
+  deadlines).  Gated: runs only under ``--concurrency`` / ``--select``.
+- :mod:`protocol` — the DLC3xx message-*shape* checkers: request arity
+  and payload, reply tokens, multi-field frame arity across
+  contract.py / broker_client.py / broker.cpp, plus lifecycle-kind
+  consistency (EventKind publishers vs dispatchers, journal kinds).
+  Gated behind ``--protocol`` / ``--select``.
+- :mod:`schedules` — the deterministic interleaving harness: virtual
+  clock + cooperative step scheduler driving the REAL heartbeat ->
+  liveness -> terminate -> recovery choreography through permuted
+  schedules (tests/test_interleaving.py).
+- :mod:`runner` — file discovery, pass gating, suppression baseline
+  (ratchet), orchestration behind ``python -m deeplearning_cfn_tpu.cli
+  lint``.
 
 Rule docs: docs/STATIC_ANALYSIS.md.
 """

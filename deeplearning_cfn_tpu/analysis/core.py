@@ -48,6 +48,11 @@ class Rule:
 
     ``applies(path)`` scopes path-specific rules (e.g. DLC002 only
     guards bench/metrics emitters); the default is every file.
+
+    ``gate`` names an opt-in pass ("concurrency"): gated rules run only
+    when explicitly selected (``--select DLC2xx`` or the pass flag), so
+    growing the rule set never changes what a plain ``dlcfn lint``
+    reports out from under the baseline.
     """
 
     id: str
@@ -55,6 +60,7 @@ class Rule:
     doc: str
     check: Callable[[ast.Module, "FileContext"], Iterable[Violation]]
     applies: Callable[[Path], bool] = field(default=lambda _p: True)
+    gate: str | None = None
 
 
 FILE_RULES: dict[str, Rule] = {}
@@ -188,6 +194,8 @@ def lint_source(
     for rule in FILE_RULES.values():
         if select is not None and rule.id not in select:
             continue
+        if select is None and rule.gate is not None:
+            continue  # gated passes are opt-in (runner/CLI selects them)
         if not rule.applies(path):
             continue
         for v in rule.check(tree, ctx):

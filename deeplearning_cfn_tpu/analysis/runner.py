@@ -6,14 +6,23 @@ import json
 from pathlib import Path
 from typing import Iterable, Iterator
 
-# Importing rules registers them in core.FILE_RULES.
+# Importing rule modules registers them in core.FILE_RULES.
+import deeplearning_cfn_tpu.analysis.concurrency as concurrency_rules
 import deeplearning_cfn_tpu.analysis.rules  # noqa: F401
-from deeplearning_cfn_tpu.analysis import contract_check
-from deeplearning_cfn_tpu.analysis.core import Violation, lint_source
+from deeplearning_cfn_tpu.analysis import contract_check, protocol
+from deeplearning_cfn_tpu.analysis.core import FILE_RULES, Violation, lint_source
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
 DEFAULT_TARGETS = ("deeplearning_cfn_tpu", "scripts", "bench.py")
+DEFAULT_BASELINE = REPO_ROOT / "scripts" / "lint_baseline.json"
 _SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "build", "dist"}
+
+PROTOCOL_RULE_IDS = (
+    protocol.RULE_REQUEST,
+    protocol.RULE_REPLY,
+    protocol.RULE_FRAME,
+    protocol.RULE_LIFECYCLE,
+)
 
 
 def discover(targets: Iterable[str | Path], root: Path = REPO_ROOT) -> Iterator[Path]:
@@ -36,16 +45,33 @@ def run_lint(
     select: set[str] | None = None,
     root: Path = REPO_ROOT,
     contract: bool = True,
+    concurrency: bool = False,
+    protocol_pass: bool = False,
 ) -> list[Violation]:
     """Lint the given targets (repo defaults when None).
 
     ``select`` limits per-file rules to specific ids; the DLC1xx contract
     checker runs unless ``contract=False`` or a ``select`` set excludes
     both DLC100 and DLC101.
+
+    The DLC2xx concurrency rules are gated: they run when
+    ``concurrency=True`` or a ``select`` names them, never implicitly.
+    Likewise the DLC3xx protocol/lifecycle checkers run when
+    ``protocol_pass=True`` or selected.
     """
+    effective_select = select
+    if select is None and concurrency:
+        # Widen the per-file selection to "every ungated rule plus the
+        # concurrency pass" — an explicit select is what lets gated rules
+        # through core.lint_source.
+        effective_select = {
+            rule.id for rule in FILE_RULES.values() if rule.gate is None
+        } | set(concurrency_rules.RULE_IDS)
+
     out: list[Violation] = []
     for path in discover(targets if targets is not None else DEFAULT_TARGETS, root):
-        out.extend(lint_source(path, select=select))
+        out.extend(lint_source(path, select=effective_select))
+
     run_contract = contract and (
         select is None or select & {contract_check.RULE_VERBS, contract_check.RULE_FIELDS}
     )
@@ -54,8 +80,81 @@ def run_lint(
         if select is not None:
             contract_violations = [v for v in contract_violations if v.rule in select]
         out.extend(contract_violations)
+
+    run_protocol = protocol_pass or (
+        select is not None and bool(select & set(PROTOCOL_RULE_IDS))
+    )
+    if run_protocol:
+        protocol_violations = protocol.check_protocol() + protocol.check_lifecycle()
+        if select is not None:
+            protocol_violations = [
+                v for v in protocol_violations if v.rule in select
+            ]
+        out.extend(protocol_violations)
+
     out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return out
+
+
+# --- suppression baseline (ratchet) ------------------------------------------
+#
+# The baseline is a committed JSON file of (rule, repo-relative path,
+# message) triples.  Findings matching an entry are suppressed; anything
+# NEW fails the build; entries that no longer match anything are reported
+# as stale so the file only ever shrinks (a ratchet, not a flag-flood).
+# Keys deliberately omit line numbers: unrelated edits above a finding
+# must not churn the baseline.
+
+
+def baseline_key(violation: Violation, root: Path = REPO_ROOT) -> tuple[str, str, str]:
+    p = Path(violation.path)
+    try:
+        rel = p.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        rel = p.as_posix()
+    return (violation.rule, rel, violation.message)
+
+
+def load_baseline(path: Path) -> set[tuple[str, str, str]]:
+    data = json.loads(Path(path).read_text())
+    entries = data.get("entries", data) if isinstance(data, dict) else data
+    out: set[tuple[str, str, str]] = set()
+    for entry in entries:
+        out.add((entry["rule"], entry["path"], entry["message"]))
+    return out
+
+
+def apply_baseline(
+    violations: list[Violation],
+    baseline: set[tuple[str, str, str]],
+    root: Path = REPO_ROOT,
+) -> tuple[list[Violation], list[tuple[str, str, str]]]:
+    """Split into (new findings, stale baseline entries)."""
+    matched: set[tuple[str, str, str]] = set()
+    fresh: list[Violation] = []
+    for v in violations:
+        key = baseline_key(v, root)
+        if key in baseline:
+            matched.add(key)
+        else:
+            fresh.append(v)
+    stale = sorted(baseline - matched)
+    return fresh, stale
+
+
+def write_baseline(
+    violations: list[Violation],
+    path: Path,
+    root: Path = REPO_ROOT,
+) -> None:
+    entries = sorted({baseline_key(v, root) for v in violations})
+    payload = {
+        "entries": [
+            {"rule": rule, "path": rel, "message": message}
+            for rule, rel, message in entries
+        ],
+    }
+    Path(path).write_text(json.dumps(payload, indent=2) + "\n")
 
 
 def render_text(violations: list[Violation]) -> str:

@@ -1,0 +1,323 @@
+"""Deterministic interleaving harness for the heartbeat/liveness plane.
+
+The DLC2xx rules claim the threaded choreography — Heartbeater beats,
+BrokerLivenessWatcher polls, LivenessTable classifies, the bus publishes
+INSTANCE_TERMINATE, recovery replaces — is safe.  This harness *confirms*
+it dynamically: a virtual clock plus a cooperative step scheduler run the
+REAL production objects (no forked logic, no real threads, no sleeps)
+through permuted schedules, including the silent-death path, and check
+ground truth at every transition:
+
+* a worker is only classified DEAD when its virtual silence really
+  exceeded ``dead_after_s`` (no false terminations under any ordering);
+* a DEAD classification always publishes exactly one INSTANCE_TERMINATE
+  until the worker is recovered;
+* every schedule runs to completion (single-threaded cooperative steps
+  cannot deadlock; a wedged invariant still fails loudly).
+
+Everything is seeded and wall-clock free, so a failing schedule is
+replayable byte-for-byte.  tests/test_interleaving.py drives >= 50
+distinct interleavings of the heartbeat-death -> recovery path through
+:class:`HeartbeatChoreography` via a pytest fixture.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Sequence
+
+from deeplearning_cfn_tpu.obs.liveness import LivenessConfig, WorkerState
+
+
+class VirtualClock:
+    """Monotonic virtual time: only :meth:`advance` moves it.  Callable so
+    it drops into every ``clock=`` seam (LivenessTable, the watcher)."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def now(self) -> float:
+        return self._now
+
+    __call__ = now
+
+    def advance(self, dt_s: float) -> float:
+        if dt_s < 0:
+            raise ValueError(f"virtual time cannot go backwards: {dt_s}")
+        self._now += dt_s
+        return self._now
+
+
+class SimBroker:
+    """The C++ broker's heartbeat table on virtual time: record() is the
+    HEARTBEAT <worker> verb, dump() the table-dump mode (worker ->
+    (age_s, count)), exactly the shape ``BrokerLivenessWatcher``'s
+    ``fetch`` seam consumes."""
+
+    def __init__(self, clock: VirtualClock):
+        self._clock = clock
+        self._beats: dict[str, tuple[float, int]] = {}
+
+    def record(self, worker: str) -> int:
+        last, count = self._beats.get(worker, (0.0, 0))
+        self._beats[worker] = (self._clock.now(), count + 1)
+        return count + 1
+
+    def dump(self) -> dict[str, tuple[float, int]]:
+        now = self._clock.now()
+        return {
+            worker: (now - last, count)
+            for worker, (last, count) in self._beats.items()
+        }
+
+    def silence_s(self, worker: str) -> float | None:
+        """Ground truth: virtual seconds since the worker's last beat."""
+        if worker not in self._beats:
+            return None
+        return self._clock.now() - self._beats[worker][0]
+
+
+class SimBrokerError(ConnectionError):
+    """Injected connection failure (a broker restart mid-beat)."""
+
+
+class SimBrokerConnection:
+    """Duck-types the BrokerConnection surface Heartbeater uses
+    (heartbeat + close).  ``fail_beats`` makes the next N beats raise, so
+    schedules exercise the real reconnect path in Heartbeater.beat_step."""
+
+    def __init__(self, broker: SimBroker, fail_beats: int = 0):
+        self._broker = broker
+        self._fail_beats = fail_beats
+        self.closed = False
+
+    def heartbeat(self, worker_id: str) -> int:
+        if self.closed:
+            raise SimBrokerError("connection is closed")
+        if self._fail_beats > 0:
+            self._fail_beats -= 1
+            raise SimBrokerError("injected beat failure")
+        return self._broker.record(worker_id)
+
+    def close(self) -> None:
+        self.closed = True
+
+
+@dataclass
+class StepScheduler:
+    """Cooperative scheduler: actors are named step functions; a schedule
+    is an explicit sequence of actor names, executed synchronously in
+    order.  No threads, no preemption — the *schedule* is the
+    interleaving."""
+
+    actors: dict[str, Callable[[], Any]] = field(default_factory=dict)
+    trace: list[str] = field(default_factory=list)
+
+    def add(self, name: str, step: Callable[[], Any]) -> None:
+        if name in self.actors:
+            raise ValueError(f"duplicate actor {name!r}")
+        self.actors[name] = step
+
+    def run(self, schedule: Iterable[str]) -> list[str]:
+        for name in schedule:
+            self.actors[name]()  # unknown actor -> KeyError, loudly
+            self.trace.append(name)
+        return self.trace
+
+
+def interleavings(
+    actions: Sequence[str],
+    count: int,
+    seed: int = 0,
+) -> list[tuple[str, ...]]:
+    """``count`` distinct seeded shuffles of ``actions``.  Deterministic:
+    the same (actions, count, seed) always yields the same schedules, so
+    a failure names its schedule reproducibly."""
+    rng = random.Random(seed)
+    seen: set[tuple[str, ...]] = set()
+    out: list[tuple[str, ...]] = []
+    attempts = 0
+    limit = count * 1000
+    while len(out) < count:
+        attempts += 1
+        if attempts > limit:
+            raise RuntimeError(
+                f"could not generate {count} distinct schedules from "
+                f"{len(actions)} actions (got {len(out)})"
+            )
+        shuffled = list(actions)
+        rng.shuffle(shuffled)
+        candidate = tuple(shuffled)
+        if candidate not in seen:
+            seen.add(candidate)
+            out.append(candidate)
+    return out
+
+
+class InvariantViolation(AssertionError):
+    """A liveness classification contradicted virtual-clock ground truth."""
+
+
+class HeartbeatChoreography:
+    """The full heartbeat-death -> recovery loop wired from REAL parts over
+    virtual time: real ``Heartbeater`` instances (driven cooperatively via
+    ``beat_step()``, never started as threads) beat at a :class:`SimBroker`;
+    a real ``BrokerLivenessWatcher`` polls it through the ``fetch`` seam
+    into the real ``LivenessTable``; DEAD transitions publish
+    INSTANCE_TERMINATE on a real ``EventBus``; the recover step replaces
+    terminated workers with fresh heartbeaters, as RecoveryManager would.
+
+    Step vocabulary (for :class:`StepScheduler` schedules):
+
+    * ``beat:<worker>``  one heartbeat from that worker (no-op once killed)
+    * ``tick``           advance the virtual clock by ``tick_s``
+    * ``poll``           watcher fetch + sweep, with ground-truth checks
+    * ``kill:<worker>``  the worker dies silently (stops beating)
+    * ``recover``        replace every terminated-but-unrecovered worker
+
+    Every ``poll`` validates transitions against the broker's own virtual
+    timeline, so no schedule can smuggle in a false DEAD or a missed one.
+    """
+
+    def __init__(
+        self,
+        workers: Sequence[str],
+        config: LivenessConfig | None = None,
+        tick_s: float = 5.0,
+        fail_first_beats: int = 0,
+    ):
+        from deeplearning_cfn_tpu.cluster.broker_service import (
+            BrokerLivenessWatcher,
+        )
+        from deeplearning_cfn_tpu.obs.heartbeat import Heartbeater
+        from deeplearning_cfn_tpu.provision.events import EventBus, EventKind
+
+        self.clock = VirtualClock()
+        self.broker = SimBroker(self.clock)
+        self.config = config or LivenessConfig()
+        self.tick_s = tick_s
+        self.bus = EventBus()
+        self.terminated: list[tuple[Any, float | None]] = []
+        self._verified = 0
+        self._terminate_kind = EventKind.INSTANCE_TERMINATE
+        self.bus.subscribe(self._on_event)
+        self.watcher = BrokerLivenessWatcher(
+            cluster_name="sim",
+            group="workers",
+            bus=self.bus,
+            config=self.config,
+            clock=self.clock,
+            fetch=self.broker.dump,
+        )
+        # A one-shot failure budget: only the FIRST dial gets the failing
+        # connection, so Heartbeater's drop-and-redial recovery actually
+        # lands a beat afterwards (a per-connection budget would fail
+        # every redial forever).
+        self._fail_budget = max(0, fail_first_beats)
+        self._mk_heartbeater = lambda worker: Heartbeater(
+            host="sim",
+            port=0,
+            worker_id=worker,
+            interval_s=tick_s,
+            connection_factory=self._dial_sim,
+        )
+        self.heartbeaters = {w: self._mk_heartbeater(w) for w in workers}
+        self.alive: set[str] = set(workers)
+        self.recovered: dict[str, str] = {}  # dead worker -> replacement
+
+    def _dial_sim(self) -> SimBrokerConnection:
+        fails, self._fail_budget = self._fail_budget, 0
+        return SimBrokerConnection(self.broker, fail_beats=fails)
+
+    # --- bus + truth checking -------------------------------------------
+    def _on_event(self, event: Any) -> None:
+        # Never raise here: EventBus isolates handler exceptions by
+        # contract, which would swallow the invariant.  Capture the
+        # ground-truth silence at publish time; poll verifies it.
+        if event.kind is self._terminate_kind:
+            self.terminated.append(
+                (event, self.broker.silence_s(event.instance_id))
+            )
+
+    def _check_terminates(self) -> None:
+        while self._verified < len(self.terminated):
+            event, silence = self.terminated[self._verified]
+            self._verified += 1
+            if silence is None or silence < self.config.dead_after_s:
+                raise InvariantViolation(
+                    f"INSTANCE_TERMINATE for {event.instance_id} at "
+                    f"virtual silence {silence}; dead_after_s="
+                    f"{self.config.dead_after_s}"
+                )
+
+    def _check_transitions(self, transitions: Iterable[Any]) -> None:
+        for worker, _old, new in transitions:
+            silence = self.broker.silence_s(worker)
+            if silence is None:
+                continue
+            if new is WorkerState.DEAD and silence < self.config.dead_after_s:
+                raise InvariantViolation(
+                    f"{worker} marked DEAD at silence {silence:.1f}s "
+                    f"< dead_after {self.config.dead_after_s}s"
+                )
+            if new is WorkerState.SUSPECT and (
+                silence < self.config.suspect_after_s
+            ):
+                raise InvariantViolation(
+                    f"{worker} marked SUSPECT at silence {silence:.1f}s "
+                    f"< suspect_after {self.config.suspect_after_s}s"
+                )
+            if new is WorkerState.ALIVE and silence >= self.config.dead_after_s:
+                raise InvariantViolation(
+                    f"{worker} marked ALIVE at silence {silence:.1f}s "
+                    f">= dead_after {self.config.dead_after_s}s"
+                )
+
+    # --- the step vocabulary --------------------------------------------
+    def step(self, action: str) -> None:
+        name, _, arg = action.partition(":")
+        if name == "beat":
+            if arg in self.alive:
+                self.heartbeaters[arg].beat_step()
+        elif name == "tick":
+            self.clock.advance(self.tick_s)
+        elif name == "poll":
+            self._check_transitions(self.watcher.poll())
+            self._check_terminates()
+        elif name == "kill":
+            self.alive.discard(arg)
+        elif name == "recover":
+            for event, _silence in list(self.terminated):
+                dead = event.instance_id
+                if dead in self.recovered:
+                    continue  # duplicate terminate: recovery is idempotent
+                replacement = f"{dead}+1"
+                self.recovered[dead] = replacement
+                self.heartbeaters[replacement] = self._mk_heartbeater(
+                    replacement
+                )
+                self.alive.add(replacement)
+                self.heartbeaters[replacement].beat_step()
+        else:
+            raise ValueError(f"unknown step {action!r}")
+
+    def run(self, schedule: Iterable[str]) -> "HeartbeatChoreography":
+        scheduler = StepScheduler()
+        executed = list(schedule)
+        for action in dict.fromkeys(executed):
+            scheduler.add(action, lambda a=action: self.step(a))
+        scheduler.run(executed)
+        if len(scheduler.trace) != len(executed):
+            raise InvariantViolation("schedule did not run to completion")
+        return self
+
+    # --- end-state assertions -------------------------------------------
+    def states(self) -> dict[str, str]:
+        return {
+            worker: info["state"]
+            for worker, info in self.watcher.snapshot().items()
+        }
+
+    def terminated_workers(self) -> list[str]:
+        return [event.instance_id for event, _silence in self.terminated]

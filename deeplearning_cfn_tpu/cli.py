@@ -823,25 +823,63 @@ def cmd_run(args) -> int:
     return 0
 
 
+# `--baseline` with no value means "the committed repo baseline"; the
+# sentinel lets cmd_lint tell that apart from an explicit path.
+_BASELINE_DEFAULT_SENTINEL = "<default-baseline>"
+
+
 def cmd_lint(args) -> int:
     """dlcfn-lint: the repo-native static-analysis pass (docs/STATIC_ANALYSIS.md).
 
     Runs the DLC0xx per-file AST rules over the package + scripts and the
-    DLC1xx cross-language broker-contract checker; exit 1 on findings."""
+    DLC1xx cross-language broker-contract checker; ``--concurrency`` adds
+    the DLC2xx lockset rules, ``--protocol`` the DLC3xx message-shape
+    checkers.  Exit 1 on findings not covered by ``--baseline``."""
     from deeplearning_cfn_tpu.analysis.runner import (
+        DEFAULT_BASELINE,
+        apply_baseline,
+        load_baseline,
         render_json,
         render_text,
         run_lint,
+        write_baseline,
     )
 
     select = None
     if args.select:
         select = {r.strip() for s in args.select for r in s.split(",") if r.strip()}
-    violations = run_lint(targets=args.paths or None, select=select)
+    violations = run_lint(
+        targets=args.paths or None,
+        select=select,
+        concurrency=args.concurrency,
+        protocol_pass=args.protocol,
+    )
+
+    baseline_path = args.baseline
+    if baseline_path is _BASELINE_DEFAULT_SENTINEL:
+        baseline_path = DEFAULT_BASELINE
+    if args.write_baseline:
+        path = Path(baseline_path) if baseline_path else DEFAULT_BASELINE
+        write_baseline(violations, path)
+        print(f"dlcfn-lint: wrote {len(violations)} entr(ies) to {path}")
+        return 0
+
+    stale: list = []
+    if baseline_path:
+        try:
+            baseline = load_baseline(baseline_path)
+        except (OSError, ValueError, KeyError, TypeError) as exc:
+            print(f"dlcfn-lint: unreadable baseline {baseline_path}: {exc}")
+            return 2
+        violations, stale = apply_baseline(violations, baseline)
     if args.format == "json":
         print(render_json(violations))
     else:
         print(render_text(violations))
+    for rule, rel, message in stale:
+        # Stale entries don't fail the build, but they do nag: the
+        # baseline is a ratchet and should only ever shrink.
+        print(f"dlcfn-lint: stale baseline entry: {rule} {rel}: {message}")
     return 1 if violations else 0
 
 
@@ -963,7 +1001,22 @@ def main(argv: list[str] | None = None) -> int:
     pl.add_argument("--select", action="append", default=[],
                     metavar="RULES",
                     help="comma-separated rule ids to run (e.g. "
-                         "DLC001,DLC100); default: all")
+                         "DLC001,DLC100); default: all ungated rules. "
+                         "Naming a gated id (DLC2xx/DLC3xx) enables it.")
+    pl.add_argument("--concurrency", action="store_true",
+                    help="also run the DLC2xx lockset/thread-escape rules")
+    pl.add_argument("--protocol", action="store_true",
+                    help="also run the DLC3xx broker message-shape and "
+                         "lifecycle-kind checkers")
+    pl.add_argument("--baseline", nargs="?", metavar="PATH", default=None,
+                    const=_BASELINE_DEFAULT_SENTINEL,
+                    help="suppress findings recorded in this baseline file "
+                         "(no value: scripts/lint_baseline.json); new "
+                         "findings still fail, stale entries are reported")
+    pl.add_argument("--write-baseline", action="store_true",
+                    dest="write_baseline",
+                    help="write the current findings to the baseline file "
+                         "instead of failing (the one ratchet-reset tool)")
     pl.set_defaults(fn=cmd_lint)
     # status reads the metrics stream / broker / journal, no template needed.
     ps = sub.add_parser(

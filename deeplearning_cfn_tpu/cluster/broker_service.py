@@ -573,8 +573,10 @@ def _unlink_lock_if_stale(lock: Path) -> None:
     would let a THIRD caller spawn a second broker concurrently."""
     try:
         holder = int(lock.read_text().strip() or 0)
-    except (FileNotFoundError, ValueError, OSError):
-        holder = 0
+    except FileNotFoundError:
+        return  # no lock to reap
+    except (ValueError, OSError):
+        holder = 0  # unreadable/corrupt: reap (verified below)
     if holder and holder != os.getpid():
         try:
             os.kill(holder, 0)
@@ -583,7 +585,34 @@ def _unlink_lock_if_stale(lock: Path) -> None:
             pass
         except PermissionError:
             return  # exists under another user: alive
-    lock.unlink(missing_ok=True)
+    # Check-then-unlink is a TOCTOU window: between the dead-holder check
+    # and the unlink, a concurrent teardown may reap the same stale lock
+    # AND a fresh ensure_broker may exclusive-create a new one — a plain
+    # unlink here would delete the new winner's lock.  Same discipline as
+    # ensure_broker's reclaim: rename (atomic, wins exactly once; losing
+    # the race is fine), verify the renamed file still names the holder
+    # we judged stale, restore it if we grabbed a newer lock.  The rename
+    # target is pid-unique so two reapers cannot collide on it either.
+    stale = lock.with_suffix(f".stale-{os.getpid()}")
+    try:
+        os.rename(lock, stale)
+    except FileNotFoundError:
+        return  # a concurrent reaper won; done either way
+    except OSError:
+        return  # cannot rename (exotic fs): leave the lock for the operator
+    try:
+        renamed_holder = int(stale.read_text().strip() or 0)
+    except (FileNotFoundError, ValueError, OSError):
+        renamed_holder = 0
+    if renamed_holder != holder:
+        # We grabbed a lock newer than the one we observed stale: it
+        # belongs to a live ensure_broker — put it back.
+        try:
+            os.rename(stale, lock)
+        except OSError:
+            pass
+        return
+    stale.unlink(missing_ok=True)
 
 
 def teardown_broker(cluster_name: str, root: Path | None = None) -> dict:

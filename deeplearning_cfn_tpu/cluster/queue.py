@@ -85,15 +85,30 @@ class InMemoryQueue(RendezvousQueue):
         self._clock = clock or MonotonicClock()
         self._lock = threading.Lock()
         self._messages: dict[str, _Stored] = {}
-        self.duplicate_next_send = False
+        self._duplicate_next_send = False
+
+    @property
+    def duplicate_next_send(self) -> bool:
+        with self._lock:
+            return self._duplicate_next_send
+
+    @duplicate_next_send.setter
+    def duplicate_next_send(self, value: bool) -> None:
+        # Tests arm this from the main thread while worker threads are
+        # mid-send; route the write through the queue lock so the flag
+        # cannot be torn between send()'s read and clear.
+        with self._lock:
+            self._duplicate_next_send = bool(value)
 
     def send(self, body: dict[str, Any]) -> str:
         # Bodies must be JSON-serializable: the wire protocol is JSON, as in
         # the reference (lambda_function.py:51-62, dl_cfn_setup_v2.py:346-357).
         json.dumps(body)
         with self._lock:
-            copies = 2 if self.duplicate_next_send else 1
-            self.duplicate_next_send = False
+            # The backing field, not the property: the lock is already
+            # held and threading.Lock does not re-enter.
+            copies = 2 if self._duplicate_next_send else 1
+            self._duplicate_next_send = False
             mid = ""
             for _ in range(copies):
                 mid = uuid.uuid4().hex

@@ -47,6 +47,7 @@ class Heartbeater(threading.Thread):
         token: str | None = None,
         interval_s: float | None = None,
         connect_timeout_s: float = 10.0,
+        connection_factory=None,
     ):
         # token=None -> BrokerConnection's ambient $DLCFN_BROKER_TOKEN
         # (how agents authenticate); pass "" for an open dev broker.
@@ -59,36 +60,60 @@ class Heartbeater(threading.Thread):
             interval_s if interval_s is not None else heartbeat_interval_s()
         )
         self.connect_timeout_s = connect_timeout_s
+        # connection_factory: () -> an object with heartbeat()/close().
+        # The seam the deterministic interleaving harness
+        # (analysis/schedules.py) injects a simulated broker through, so
+        # beat_step() can be driven cooperatively without sockets.
+        self._connection_factory = connection_factory
         self.beats_sent = 0
+        # beats_sent is read by other threads (status displays, tests);
+        # the daemon loop increments it only under this lock.
+        self._lock = threading.Lock()
         # not named _stop: threading.Thread's join internals
         # call a private _stop() method of that name.
         self._halt = threading.Event()
         self._conn = None
 
-    def _beat_once(self) -> None:
+    def _dial(self):
+        if self._connection_factory is not None:
+            return self._connection_factory()
         from deeplearning_cfn_tpu.cluster.broker_client import BrokerConnection
 
+        return BrokerConnection(
+            self.host,
+            self.port,
+            token=self.token,
+            timeout_s=self.connect_timeout_s,
+        )
+
+    def _beat_once(self) -> None:
         if self._conn is None:
-            self._conn = BrokerConnection(
-                self.host,
-                self.port,
-                token=self.token,
-                timeout_s=self.connect_timeout_s,
-            )
+            self._conn = self._dial()
         self._conn.heartbeat(self.worker_id)
-        self.beats_sent += 1
+        with self._lock:
+            self.beats_sent += 1
+
+    def beat_step(self) -> bool:
+        """One protected beat iteration (the body of the daemon loop).
+
+        Public so the interleaving harness can drive the REAL beat +
+        reconnect logic cooperatively; returns whether the beat landed.
+        """
+        try:
+            self._beat_once()
+            return True
+        except Exception as exc:
+            # Drop the wedged connection; next beat dials fresh.
+            log.warning("heartbeat to %s:%d failed: %s", self.host, self.port, exc)
+            self._close_conn()
+            return False
 
     def run(self) -> None:
         get_recorder().record(
             "heartbeater_start", worker=self.worker_id, interval_s=self.interval_s
         )
         while not self._halt.is_set():
-            try:
-                self._beat_once()
-            except Exception as exc:
-                # Drop the wedged connection; next loop dials fresh.
-                log.warning("heartbeat to %s:%d failed: %s", self.host, self.port, exc)
-                self._close_conn()
+            self.beat_step()
             self._halt.wait(self.interval_s)
         self._close_conn()
 

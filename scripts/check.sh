@@ -1,13 +1,16 @@
 #!/usr/bin/env bash
-# One gate, two halves: the repo-native lint pass (dlcfn lint, including
-# the DLC100/101 broker-contract checker) then the tier-1 test suite —
-# exactly the commands ROADMAP.md designates, so CI and a developer's
-# pre-push run cannot drift apart.
+# One gate, two halves: the repo-native lint pass (dlcfn lint with every
+# gated pass on — DLC0xx per-file rules, DLC1xx broker-contract checker,
+# DLC2xx concurrency lockset rules, DLC3xx message-shape/lifecycle
+# checkers — ratcheted against the committed suppression baseline) then
+# the tier-1 test suite — exactly the commands ROADMAP.md designates, so
+# CI and a developer's pre-push run cannot drift apart.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== dlcfn lint =="
-python -m deeplearning_cfn_tpu.cli lint || exit 1
+echo "== dlcfn lint (full: --concurrency --protocol, baselined) =="
+python -m deeplearning_cfn_tpu.cli lint --concurrency --protocol \
+  --baseline scripts/lint_baseline.json || exit 1
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

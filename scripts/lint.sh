@@ -2,6 +2,16 @@
 # dlcfn-lint CI entry: the repo-native static-analysis pass
 # (docs/STATIC_ANALYSIS.md).  Lints the package, scripts/, and bench.py;
 # exit 1 on any finding, including broker-contract drift (DLC100/101).
+# Opt-in passes: --concurrency (DLC2xx), --protocol (DLC3xx), --baseline.
+# --json is shorthand for --format json (machine-readable findings).
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec python -m deeplearning_cfn_tpu.cli lint "$@"
+args=()
+for a in "$@"; do
+  if [[ "$a" == "--json" ]]; then
+    args+=(--format json)
+  else
+    args+=("$a")
+  fi
+done
+exec python -m deeplearning_cfn_tpu.cli lint "${args[@]+"${args[@]}"}"

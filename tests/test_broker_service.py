@@ -9,6 +9,7 @@ import json
 import os
 import re
 import shutil
+from pathlib import Path
 
 import pytest
 
@@ -401,6 +402,84 @@ def test_stale_lock_from_dead_holder_is_reclaimed(tmp_path):
         assert broker_status("svc", root=tmp_path)["alive"] is True
     finally:
         teardown_broker("svc", root=tmp_path)
+
+
+def test_unlink_lock_tolerates_concurrent_reaper(tmp_path, monkeypatch):
+    """Two teardowns racing on the same stale lock: the loser's rename
+    hits FileNotFoundError and must treat it as success (the lock is
+    gone either way), not crash the teardown."""
+    from deeplearning_cfn_tpu.cluster.broker_service import _unlink_lock_if_stale
+
+    import subprocess
+    import sys
+
+    lock = tmp_path / "svc.lock"
+    # Missing lock: plain no-op.
+    _unlink_lock_if_stale(lock)
+
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    lock.write_text(str(proc.pid))
+
+    real_rename = os.rename
+
+    def stealing_rename(src, dst):
+        # The concurrent reaper wins between our staleness check and our
+        # rename: the lock vanishes out from under us.
+        os.unlink(src)
+        raise FileNotFoundError(src)
+
+    monkeypatch.setattr(os, "rename", stealing_rename)
+    _unlink_lock_if_stale(lock)  # must not raise
+    monkeypatch.setattr(os, "rename", real_rename)
+    assert not lock.exists()
+    assert list(tmp_path.glob("*.stale*")) == []
+
+
+def test_unlink_lock_restores_fresh_lock_grabbed_by_mistake(tmp_path, monkeypatch):
+    """The full TOCTOU: the stale lock is reaped by a peer AND a new
+    ensure_broker exclusive-creates a fresh lock before our rename — we
+    grab the NEW holder's lock, must notice the pid changed, and put it
+    back instead of deleting a live winner's lock."""
+    from deeplearning_cfn_tpu.cluster.broker_service import _unlink_lock_if_stale
+
+    import subprocess
+    import sys
+
+    lock = tmp_path / "svc.lock"
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    lock.write_text(str(proc.pid))  # the stale lock we observe
+
+    real_rename = os.rename
+
+    def racing_rename(src, dst):
+        # Between observation and rename the lock is replaced by a live
+        # winner's (same path, new content); the rename takes the new one.
+        Path(src).write_text(str(os.getpid()))
+        real_rename(src, dst)
+
+    monkeypatch.setattr(os, "rename", racing_rename)
+    _unlink_lock_if_stale(lock)
+    monkeypatch.setattr(os, "rename", real_rename)
+    assert lock.exists(), "live winner's lock must be restored"
+    assert lock.read_text() == str(os.getpid())
+    assert list(tmp_path.glob("*.stale*")) == []
+
+
+def test_unlink_lock_reaps_dead_holder_without_residue(tmp_path):
+    from deeplearning_cfn_tpu.cluster.broker_service import _unlink_lock_if_stale
+
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    lock = tmp_path / "svc.lock"
+    lock.write_text(str(proc.pid))
+    _unlink_lock_if_stale(lock)
+    assert not lock.exists()
+    assert list(tmp_path.glob("*.stale*")) == []
 
 
 def test_teardown_stale_record_does_not_kill_recycled_pid(tmp_path):

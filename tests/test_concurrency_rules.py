@@ -1,0 +1,323 @@
+"""DLC2xx concurrency-rule fixtures: every lockset/thread-escape rule
+fires on its seeded race and stays silent on the repo's guarded idiom
+(docs/STATIC_ANALYSIS.md).
+
+The DLC2xx pass is *gated*: a plain ``lint_source`` (select=None) must
+never run it, so each case passes an explicit ``select`` — exactly how
+the runner enables the pass under ``dlcfn lint --concurrency``.
+"""
+
+import textwrap
+
+from deeplearning_cfn_tpu.analysis import lint_source
+from deeplearning_cfn_tpu.analysis.concurrency import RULE_IDS
+
+
+def rules_for(
+    src: str,
+    select: set[str],
+    path: str = "deeplearning_cfn_tpu/cluster/x.py",
+):
+    return [v.rule for v in lint_source(path, textwrap.dedent(src), select=select)]
+
+
+# --- the gate itself --------------------------------------------------------
+
+def test_gated_rules_do_not_run_without_select():
+    """The whole point of the gate: growing the DLC2xx set must never
+    change what a plain `dlcfn lint` reports."""
+    src = """\
+        import threading
+
+        class Counter(threading.Thread):
+            def __init__(self):
+                super().__init__()
+                self.total = 0
+
+            def run(self):
+                self.total += 1
+    """
+    fired = [
+        v.rule
+        for v in lint_source(
+            "deeplearning_cfn_tpu/cluster/x.py", textwrap.dedent(src)
+        )
+    ]
+    assert not set(fired) & set(RULE_IDS)
+    assert rules_for(src, select={"DLC201"}) == ["DLC201"]
+
+
+# --- DLC201: unlocked shared attribute --------------------------------------
+
+def test_dlc201_fires_on_unlocked_public_write_in_run():
+    src = """\
+        import threading
+
+        class Counter(threading.Thread):
+            def __init__(self):
+                super().__init__()
+                self.total = 0
+
+            def run(self):
+                self.total += 1
+    """
+    assert rules_for(src, {"DLC201"}) == ["DLC201"]
+
+
+def test_dlc201_fires_on_target_method_write_read_by_main_side():
+    src = """\
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._sent = 0
+                self.thread = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                self._sent += 1
+
+            def sent(self):
+                return self._sent
+    """
+    assert rules_for(src, {"DLC201"}) == ["DLC201"]
+
+
+def test_dlc201_silent_when_both_sides_hold_the_lock():
+    src = """\
+        import threading
+
+        class Counter(threading.Thread):
+            def __init__(self):
+                super().__init__()
+                self._lock = threading.Lock()
+                self.total = 0
+
+            def run(self):
+                with self._lock:
+                    self.total += 1
+
+            def value(self):
+                with self._lock:
+                    return self.total
+    """
+    assert rules_for(src, {"DLC201"}) == []
+
+
+def test_dlc201_silent_on_private_thread_local_scratch_and_event():
+    src = """\
+        import threading
+
+        class Looper(threading.Thread):
+            def __init__(self):
+                super().__init__()
+                self._halt = threading.Event()
+
+            def run(self):
+                self._scratch = 0
+                while not self._halt.is_set():
+                    self._scratch += 1
+    """
+    assert rules_for(src, {"DLC201"}) == []
+
+
+def test_dlc201_silent_on_classes_that_spawn_no_thread():
+    src = """\
+        class Plain:
+            def bump(self):
+                self.total = 1
+    """
+    assert rules_for(src, {"DLC201"}) == []
+
+
+# --- DLC202: bare acquire() -------------------------------------------------
+
+def test_dlc202_fires_on_bare_acquire():
+    src = """\
+        import threading
+        lock = threading.Lock()
+
+        def f(work):
+            lock.acquire()
+            work()
+            lock.release()
+    """
+    assert rules_for(src, {"DLC202"}) == ["DLC202"]
+
+
+def test_dlc202_silent_with_try_finally_release():
+    follower = """\
+        import threading
+        lock = threading.Lock()
+
+        def f(work):
+            lock.acquire()
+            try:
+                work()
+            finally:
+                lock.release()
+    """
+    inside = """\
+        import threading
+        lock = threading.Lock()
+
+        def g(work):
+            try:
+                lock.acquire()
+                work()
+            finally:
+                lock.release()
+    """
+    assert rules_for(follower, {"DLC202"}) == []
+    assert rules_for(inside, {"DLC202"}) == []
+
+
+def test_dlc202_ignores_non_lock_receivers():
+    # e.g. a semaphore-free resource pool with an acquire() API of its own
+    src = """\
+        def f(pool):
+            pool.acquire()
+    """
+    assert rules_for(src, {"DLC202"}) == []
+
+
+# --- DLC203: blocking I/O under a lock --------------------------------------
+
+def test_dlc203_fires_on_sleep_and_subprocess_under_lock():
+    src = """\
+        import subprocess
+        import threading
+        import time
+
+        lock = threading.Lock()
+
+        def f():
+            with lock:
+                time.sleep(1.0)
+                subprocess.run(["true"], timeout=5)
+    """
+    assert rules_for(src, {"DLC203"}) == ["DLC203", "DLC203"]
+
+
+def test_dlc203_silent_outside_the_with_and_in_nested_defs():
+    src = """\
+        import threading
+        import time
+
+        lock = threading.Lock()
+
+        def f(register):
+            with lock:
+                def callback():
+                    time.sleep(1.0)
+                register(callback)
+            time.sleep(1.0)
+    """
+    assert rules_for(src, {"DLC203"}) == []
+
+
+def test_dlc203_fires_on_socket_recv_under_lock():
+    src = """\
+        import threading
+
+        lock = threading.Lock()
+
+        def f(sock):
+            with lock:
+                return sock.recv(4096)
+    """
+    assert rules_for(src, {"DLC203"}) == ["DLC203"]
+
+
+# --- DLC204: daemon thread without a stop path ------------------------------
+
+def test_dlc204_fires_on_unstoppable_daemon_subclass():
+    src = """\
+        import threading
+
+        class Beater(threading.Thread):
+            def __init__(self):
+                super().__init__(daemon=True)
+
+            def run(self):
+                while True:
+                    pass
+    """
+    assert rules_for(src, {"DLC204"}) == ["DLC204"]
+
+
+def test_dlc204_silent_with_halt_event():
+    src = """\
+        import threading
+
+        class Beater(threading.Thread):
+            def __init__(self):
+                super().__init__(daemon=True)
+                self._halt = threading.Event()
+
+            def run(self):
+                while not self._halt.is_set():
+                    self._halt.wait(1.0)
+
+            def stop(self):
+                self._halt.set()
+                self.join(timeout=5.0)
+    """
+    assert rules_for(src, {"DLC204"}) == []
+
+
+def test_dlc204_fires_on_bare_daemon_thread_call():
+    src = """\
+        import threading
+
+        def spawn(loop):
+            t = threading.Thread(target=loop, daemon=True)
+            t.start()
+            return t
+    """
+    assert rules_for(src, {"DLC204"}) == ["DLC204"]
+
+
+def test_dlc204_silent_when_call_scope_joins():
+    src = """\
+        import threading
+
+        def spawn(loop):
+            t = threading.Thread(target=loop, daemon=True)
+            t.start()
+            t.join(timeout=5.0)
+    """
+    assert rules_for(src, {"DLC204"}) == []
+
+
+# --- DLC205: wall-clock liveness timing -------------------------------------
+
+def test_dlc205_fires_on_deadline_arithmetic_and_named_binding():
+    src = """\
+        import time
+
+        def f(start):
+            deadline = time.time() + 30.0
+            cutoff = time.time()
+            if time.time() - start > 5.0:
+                return deadline, cutoff
+    """
+    assert rules_for(src, {"DLC205"}) == ["DLC205"] * 3
+
+
+def test_dlc205_silent_on_record_metadata_and_plain_stamp():
+    src = """\
+        import time
+
+        def f():
+            stamp = time.time()
+            return {"started_ts": time.time(), "at": stamp}
+    """
+    assert rules_for(src, {"DLC205"}) == []
+
+
+def test_dlc205_scoped_to_timing_paths():
+    src = """\
+        import time
+        deadline = time.time() + 30.0
+    """
+    assert rules_for(src, {"DLC205"}, path="deeplearning_cfn_tpu/train/x.py") == []

@@ -1,0 +1,137 @@
+"""Deterministic interleaving exploration of the heartbeat-death ->
+recovery choreography (analysis/schedules.py).
+
+The acceptance bar: >= 50 *distinct* schedules of the silent-death path
+run to completion on the REAL Heartbeater / BrokerLivenessWatcher /
+LivenessTable / EventBus objects over virtual time, with every
+transition and every INSTANCE_TERMINATE checked against the broker's own
+ground-truth silence.  No real threads, no sleeps, no wall clock — a
+failing schedule replays byte-for-byte from its seed.
+"""
+
+import pytest
+
+from deeplearning_cfn_tpu.analysis.schedules import (
+    HeartbeatChoreography,
+    InvariantViolation,
+    StepScheduler,
+    VirtualClock,
+    interleavings,
+)
+from deeplearning_cfn_tpu.obs.liveness import LivenessConfig, WorkerState
+
+
+@pytest.fixture
+def choreography():
+    """Factory for a two-worker choreography on the default thresholds
+    (suspect 15s, dead 60s) with a 5s tick."""
+
+    def make(**kwargs) -> HeartbeatChoreography:
+        return HeartbeatChoreography(
+            ["w0", "w1"],
+            config=LivenessConfig(suspect_after_s=15.0, dead_after_s=60.0),
+            tick_s=5.0,
+            **kwargs,
+        )
+
+    return make
+
+
+# Registration prefix: both workers must enter the broker table before
+# anything races, or there is nothing for the watcher to classify.
+PREFIX = ["beat:w0", "beat:w1", "poll"]
+
+# The raced region: w0's death shuffles freely against beats, clock
+# ticks, and watcher polls — including orderings where w0 beats again
+# right before (or as a no-op after) the kill.
+MIDDLE = (
+    "beat:w0",
+    "beat:w1",
+    "beat:w1",
+    "tick",
+    "tick",
+    "tick",
+    "poll",
+    "kill:w0",
+    "poll",
+)
+
+# Drain: 13 ticks (65 virtual seconds > dead_after 60) with w1 still
+# beating, so w0 must be classified DEAD and w1 must not be.
+DRAIN = ["beat:w1", "tick"] * 13 + ["poll"]
+
+
+def test_fifty_plus_death_recovery_interleavings(choreography):
+    middles = interleavings(MIDDLE, count=56, seed=7)
+    assert len(set(middles)) == 56  # distinct by construction
+    for middle in middles:
+        schedule = PREFIX + list(middle) + DRAIN + ["recover", "poll"]
+        choreo = choreography().run(schedule)
+        states = choreo.states()
+        # The victim died and exactly one terminate was published for it.
+        assert states["w0"] == WorkerState.DEAD.value
+        assert choreo.terminated_workers().count("w0") == 1
+        # The survivor kept beating and was never terminated.
+        assert states["w1"] == WorkerState.ALIVE.value
+        assert "w1" not in choreo.terminated_workers()
+        # Recovery replaced the victim; the replacement's beat landed.
+        assert choreo.recovered == {"w0": "w0+1"}
+        assert states["w0+1"] == WorkerState.ALIVE.value
+
+
+def test_no_false_termination_while_everyone_beats(choreography):
+    """Orderings without a kill must never terminate anyone, no matter
+    how beats, ticks, and polls interleave (worst case: 15s of silence ->
+    SUSPECT, then resurrection on the next beat)."""
+    actions = ("beat:w0", "beat:w1", "tick", "tick", "tick", "poll", "poll")
+    for middle in interleavings(actions, count=24, seed=11):
+        choreo = choreography().run(PREFIX + list(middle) + ["poll"])
+        assert choreo.terminated_workers() == []
+        assert WorkerState.DEAD.value not in choreo.states().values()
+
+
+def test_truth_checking_is_not_vacuous(choreography):
+    """A fabricated DEAD transition for a freshly-beating worker must be
+    rejected — proving the invariant machinery can actually fail."""
+    choreo = choreography()
+    choreo.run(["beat:w0", "poll"])
+    with pytest.raises(InvariantViolation):
+        choreo._check_transitions(
+            [("w0", WorkerState.ALIVE, WorkerState.DEAD)]
+        )
+
+
+def test_injected_beat_failure_exercises_real_reconnect(choreography):
+    """The broker-restart path: the first dial fails, Heartbeater drops
+    the connection, and the next beat lands on a fresh dial."""
+    choreo = choreography(fail_first_beats=1)
+    hb = choreo.heartbeaters["w0"]
+    assert hb.beat_step() is False
+    assert hb.beats_sent == 0
+    assert hb.beat_step() is True
+    assert hb.beats_sent == 1
+
+
+def test_interleavings_are_deterministic_and_distinct():
+    first = interleavings(MIDDLE, count=10, seed=3)
+    again = interleavings(MIDDLE, count=10, seed=3)
+    assert first == again
+    assert len(set(first)) == 10
+    assert interleavings(MIDDLE, count=10, seed=4) != first
+
+
+def test_scheduler_fails_loudly_on_unknown_actor():
+    sched = StepScheduler()
+    sched.add("a", lambda: None)
+    with pytest.raises(KeyError):
+        sched.run(["a", "ghost"])
+    with pytest.raises(ValueError):
+        sched.add("a", lambda: None)  # duplicate actor
+
+
+def test_virtual_clock_is_monotonic():
+    clock = VirtualClock()
+    clock.advance(5.0)
+    assert clock() == 5.0
+    with pytest.raises(ValueError):
+        clock.advance(-1.0)
