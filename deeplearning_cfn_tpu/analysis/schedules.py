@@ -85,16 +85,28 @@ class SimBrokerError(ConnectionError):
 class SimBrokerConnection:
     """Duck-types the BrokerConnection surface Heartbeater uses
     (heartbeat + close).  ``fail_beats`` makes the next N beats raise, so
-    schedules exercise the real reconnect path in Heartbeater.beat_step."""
+    schedules exercise the real reconnect path in Heartbeater.beat_step.
+    ``fail_when`` is the partition predicate: while it returns True every
+    beat raises (and so does every beat on a freshly redialed connection
+    built with the same predicate), which models a network cut rather
+    than a one-shot connection loss."""
 
-    def __init__(self, broker: SimBroker, fail_beats: int = 0):
+    def __init__(
+        self,
+        broker: SimBroker,
+        fail_beats: int = 0,
+        fail_when: Callable[[], bool] | None = None,
+    ):
         self._broker = broker
         self._fail_beats = fail_beats
+        self._fail_when = fail_when
         self.closed = False
 
     def heartbeat(self, worker_id: str) -> int:
         if self.closed:
             raise SimBrokerError("connection is closed")
+        if self._fail_when is not None and self._fail_when():
+            raise SimBrokerError("network partition")
         if self._fail_beats > 0:
             self._fail_beats -= 1
             raise SimBrokerError("injected beat failure")
@@ -174,6 +186,8 @@ class HeartbeatChoreography:
     * ``tick``           advance the virtual clock by ``tick_s``
     * ``poll``           watcher fetch + sweep, with ground-truth checks
     * ``kill:<worker>``  the worker dies silently (stops beating)
+    * ``cut:<worker>``   network partition: its beats fail until healed
+    * ``heal:<worker>``  the partition heals; its beats land again
     * ``recover``        replace every terminated-but-unrecovered worker
 
     Every ``poll`` validates transitions against the broker's own virtual
@@ -215,20 +229,32 @@ class HeartbeatChoreography:
         # lands a beat afterwards (a per-connection budget would fail
         # every redial forever).
         self._fail_budget = max(0, fail_first_beats)
+        # Workers currently on the wrong side of a network cut: their
+        # beats (on live AND freshly redialed connections) raise until a
+        # heal step removes them.
+        self.partitioned: set[str] = set()
         self._mk_heartbeater = lambda worker: Heartbeater(
             host="sim",
             port=0,
             worker_id=worker,
             interval_s=tick_s,
-            connection_factory=self._dial_sim,
+            connection_factory=lambda w=worker: self._dial_sim(w),
         )
         self.heartbeaters = {w: self._mk_heartbeater(w) for w in workers}
         self.alive: set[str] = set(workers)
         self.recovered: dict[str, str] = {}  # dead worker -> replacement
 
-    def _dial_sim(self) -> SimBrokerConnection:
+    def _dial_sim(self, worker: str | None = None) -> SimBrokerConnection:
         fails, self._fail_budget = self._fail_budget, 0
-        return SimBrokerConnection(self.broker, fail_beats=fails)
+        return SimBrokerConnection(
+            self.broker,
+            fail_beats=fails,
+            fail_when=(
+                (lambda: worker in self.partitioned)
+                if worker is not None
+                else None
+            ),
+        )
 
     # --- bus + truth checking -------------------------------------------
     def _on_event(self, event: Any) -> None:
@@ -287,6 +313,12 @@ class HeartbeatChoreography:
             self._check_terminates()
         elif name == "kill":
             self.alive.discard(arg)
+        elif name == "cut":
+            # Network partition: the worker keeps trying to beat (stays in
+            # alive) but every beat fails until healed.
+            self.partitioned.add(arg)
+        elif name == "heal":
+            self.partitioned.discard(arg)
         elif name == "recover":
             for event, _silence in list(self.terminated):
                 dead = event.instance_id

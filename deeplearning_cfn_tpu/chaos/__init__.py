@@ -1,0 +1,36 @@
+"""Chaos-injection layer: seeded, deterministic faults over real seams.
+
+``injectors`` wraps the seams the production stack already exposes
+(GCPTransport's ``opener``, Heartbeater's ``connection_factory``, the
+RendezvousQueue interface, checkpoint I/O) with seeded fault models;
+``scenarios`` composes them into named end-to-end soaks — silent-death,
+partition, flaky-rpc, slow-disk — that drive the REAL components over
+virtual time and assert recovery invariants.  ``dlcfn chaos`` is the CLI
+entry point; tests/test_chaos.py the regression harness.
+"""
+
+from deeplearning_cfn_tpu.chaos.injectors import (
+    ChaosQueue,
+    FlakyOpener,
+    RecordingClock,
+    SlowDisk,
+    StallingConnectionFactory,
+    TornDisk,
+)
+from deeplearning_cfn_tpu.chaos.scenarios import (
+    SCENARIOS,
+    ScenarioReport,
+    run_scenario,
+)
+
+__all__ = [
+    "ChaosQueue",
+    "FlakyOpener",
+    "RecordingClock",
+    "SCENARIOS",
+    "ScenarioReport",
+    "SlowDisk",
+    "StallingConnectionFactory",
+    "TornDisk",
+    "run_scenario",
+]

@@ -1,0 +1,562 @@
+"""Named chaos scenarios: real components + seeded faults + invariants.
+
+Each scenario drives PRODUCTION objects (Heartbeater,
+BrokerLivenessWatcher, GoogleAuthTransport, StateCheckpointer,
+ResilientSink, InMemoryQueue) through seeded fault schedules on virtual
+clocks — no real sleeps, no wall-clock dependence — and records which
+recovery invariants held.  ``run_scenario(name, seed)`` returns a
+:class:`ScenarioReport` whose ``to_dict()`` is byte-identical across
+runs with the same seed, which is what the regression tests and the
+``dlcfn chaos`` CLI assert.
+
+Catalog:
+
+* ``silent-death`` — a worker stops beating under shuffled schedules;
+  exactly-once termination + recovery (the PR-2 acceptance path, now
+  fault-injected across many interleavings per seed).
+* ``partition``   — short cuts must NOT kill anyone; long cuts must kill
+  exactly once; healed workers resurrect; the metrics plane buffers
+  through the outage (grace window) and message chaos cannot break
+  at-least-once consumers.
+* ``flaky-rpc``   — error bursts against the retry policy (jitter-bounded
+  backoff on a fake clock) and a hard-down outage against the circuit
+  breaker (fail-fast, half-open probe, re-trip).
+* ``slow-disk``   — torn and slow checkpoint writes against the atomic
+  write protocol and the local -> objectstore fallback chain.
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable
+
+from deeplearning_cfn_tpu.chaos.injectors import (
+    ChaosQueue,
+    FlakyOpener,
+    RecordingClock,
+    SlowDisk,
+    TornDisk,
+)
+from deeplearning_cfn_tpu.utils.timeouts import FakeClock
+
+
+@dataclass
+class ScenarioReport:
+    """What a scenario proved (and what it could not)."""
+
+    name: str
+    seed: int
+    passed: bool = True
+    invariants: list[str] = field(default_factory=list)
+    violations: list[str] = field(default_factory=list)
+    details: dict[str, Any] = field(default_factory=dict)
+
+    def check(self, condition: bool, description: str) -> None:
+        if condition:
+            self.invariants.append(description)
+        else:
+            self.violations.append(description)
+            self.passed = False
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "scenario": self.name,
+            "seed": self.seed,
+            "passed": self.passed,
+            "invariants": list(self.invariants),
+            "violations": list(self.violations),
+            "details": dict(self.details),
+        }
+
+
+def _degraded_event_count() -> int:
+    from deeplearning_cfn_tpu.obs.recorder import get_recorder
+
+    return sum(
+        1 for e in get_recorder().tail(4096) if e.get("kind") == "degraded"
+    )
+
+
+# --- silent-death ------------------------------------------------------------
+
+_SD_PREFIX = ["beat:w0", "beat:w1", "poll"]
+_SD_MIDDLE = (
+    "beat:w0",
+    "beat:w1",
+    "beat:w1",
+    "tick",
+    "tick",
+    "poll",
+    "kill:w0",
+    "poll",
+)
+_SD_DRAIN = ["beat:w1", "tick"] * 13 + ["poll"]
+
+
+def silent_death(seed: int) -> ScenarioReport:
+    """A worker dies silently under several seeded interleavings; the
+    liveness plane must terminate it exactly once and recovery must
+    replace it, with the survivor untouched."""
+    from deeplearning_cfn_tpu.analysis.schedules import (
+        HeartbeatChoreography,
+        InvariantViolation,
+        interleavings,
+    )
+    from deeplearning_cfn_tpu.obs.liveness import LivenessConfig, WorkerState
+
+    report = ScenarioReport("silent-death", seed)
+    schedules = interleavings(_SD_MIDDLE, count=6, seed=seed)
+    terminations = 0
+    for middle in schedules:
+        choreo = HeartbeatChoreography(
+            ["w0", "w1"],
+            config=LivenessConfig(suspect_after_s=15.0, dead_after_s=60.0),
+            tick_s=5.0,
+        )
+        try:
+            choreo.run(_SD_PREFIX + list(middle) + _SD_DRAIN + ["recover", "poll"])
+        except InvariantViolation as violation:
+            report.check(False, f"ground-truth invariant: {violation}")
+            continue
+        states = choreo.states()
+        report.check(
+            states.get("w0") == WorkerState.DEAD.value,
+            "silently-dead worker classified DEAD",
+        )
+        w0_terminations = choreo.terminated_workers().count("w0")
+        terminations += w0_terminations
+        report.check(
+            w0_terminations == 1, "exactly one INSTANCE_TERMINATE for the victim"
+        )
+        report.check(
+            states.get("w1") == WorkerState.ALIVE.value
+            and "w1" not in choreo.terminated_workers(),
+            "survivor stayed ALIVE and was never terminated",
+        )
+        report.check(
+            choreo.recovered == {"w0": "w0+1"}
+            and states.get("w0+1") == WorkerState.ALIVE.value,
+            "recovery replaced the victim; replacement is beating",
+        )
+    report.details.update(
+        schedules=len(schedules), terminations=terminations
+    )
+    return report
+
+
+# --- partition ---------------------------------------------------------------
+
+
+class _FlappingSink:
+    """A metrics sink that raises OSError while ``down``."""
+
+    def __init__(self) -> None:
+        self.down = False
+        self.records: list[dict] = []
+
+    def write(self, record: dict) -> None:
+        if self.down:
+            raise OSError("sink unreachable (partition)")
+        self.records.append(record)
+
+    def close(self) -> None:
+        pass
+
+
+def partition(seed: int) -> ScenarioReport:
+    """Network cuts: short ones must not kill, long ones must kill
+    exactly once, healing resurrects; meanwhile the metrics plane rides
+    out the outage inside its grace window and queue-level chaos cannot
+    break the at-least-once consumer contract."""
+    from deeplearning_cfn_tpu.analysis.schedules import (
+        HeartbeatChoreography,
+        InvariantViolation,
+        interleavings,
+    )
+    from deeplearning_cfn_tpu.cluster.queue import InMemoryQueue
+    from deeplearning_cfn_tpu.obs.liveness import LivenessConfig, WorkerState
+    from deeplearning_cfn_tpu.train.metrics import MetricsOutage, ResilientSink
+
+    report = ScenarioReport("partition", seed)
+
+    # -- liveness under cut/heal ----------------------------------------
+    short_cut = ("beat:w0", "beat:w1", "tick", "tick", "poll")
+    for middle in interleavings(short_cut, count=4, seed=seed):
+        choreo = HeartbeatChoreography(
+            ["w0", "w1"],
+            config=LivenessConfig(suspect_after_s=15.0, dead_after_s=60.0),
+            tick_s=5.0,
+        )
+        try:
+            # Short partition (10 virtual seconds < suspect threshold),
+            # then heal: nobody may be terminated.
+            choreo.run(
+                _SD_PREFIX
+                + ["cut:w0"]
+                + list(middle)
+                + ["heal:w0", "beat:w0", "poll"]
+            )
+            report.check(
+                choreo.terminated_workers() == []
+                and choreo.states().get("w0") == WorkerState.ALIVE.value,
+                "short partition: no termination, worker ALIVE after heal",
+            )
+            # Long partition: w0 cut past dead_after (65 virtual s) while
+            # w1 keeps beating -> exactly one terminate, then recovery,
+            # then heal resurrects the original.
+            choreo.run(
+                ["cut:w0"]
+                + ["beat:w0", "beat:w1", "tick"] * 13
+                + ["poll", "recover", "heal:w0", "beat:w0", "poll"]
+            )
+        except InvariantViolation as violation:
+            report.check(False, f"ground-truth invariant: {violation}")
+            continue
+        states = choreo.states()
+        report.check(
+            choreo.terminated_workers().count("w0") == 1,
+            "long partition: exactly one INSTANCE_TERMINATE",
+        )
+        report.check(
+            "w1" not in choreo.terminated_workers()
+            and states.get("w1") == WorkerState.ALIVE.value,
+            "worker on the healthy side never terminated",
+        )
+        report.check(
+            states.get("w0") == WorkerState.ALIVE.value,
+            "healed worker resurrected to ALIVE",
+        )
+        report.check(
+            choreo.recovered.get("w0") == "w0+1"
+            and states.get("w0+1") == WorkerState.ALIVE.value,
+            "recovery brought up a replacement during the cut",
+        )
+
+    # -- trainer grace window -------------------------------------------
+    clock = FakeClock()
+    inner = _FlappingSink()
+    sink = ResilientSink(inner, grace_s=120.0, clock=clock)
+    sink.write({"step": 0})
+    inner.down = True
+    buffered = 0
+    for step in range(1, 6):  # 5 writes over 50 virtual s of outage
+        clock.advance(10.0)
+        sink.write({"step": step})
+        buffered = sink.buffered
+    report.check(
+        buffered == 5 and sink.degraded,
+        "metrics outage inside grace window: writes buffered, no raise",
+    )
+    inner.down = False
+    sink.write({"step": 6})
+    report.check(
+        sink.buffered == 0
+        and not sink.degraded
+        and [r["step"] for r in inner.records] == list(range(7)),
+        "sink recovery flushed the buffer in order, nothing lost",
+    )
+    inner.down = True
+    outage_raised = False
+    try:
+        for step in range(7, 30):
+            clock.advance(30.0)
+            sink.write({"step": step})
+    except MetricsOutage:
+        outage_raised = True
+    report.check(
+        outage_raised, "outage past the grace window raises typed MetricsOutage"
+    )
+
+    # -- message chaos vs at-least-once consumers -----------------------
+    chaos_q = ChaosQueue(
+        InMemoryQueue("chaos", clock=clock),
+        seed=seed,
+        drop_rate=0.1,
+        delay_rate=0.2,
+        delay_ops=2,
+        duplicate_rate=0.2,
+        reorder=True,
+    )
+    sent = 30
+    for i in range(sent):
+        chaos_q.send({"event": "worker-setup", "id": i})
+    seen: set[int] = set()
+    deliveries = 0
+    for _sweep in range(50):
+        messages = chaos_q.receive(max_messages=10, visibility_timeout_s=60.0)
+        if not messages and not chaos_q._held:
+            break
+        for msg in messages:
+            deliveries += 1
+            seen.add(int(msg.body["id"]))
+            chaos_q.delete(msg.receipt)
+    chaos_q.flush_held()
+    for _sweep in range(10):
+        messages = chaos_q.receive(max_messages=10, visibility_timeout_s=60.0)
+        if not messages:
+            break
+        for msg in messages:
+            deliveries += 1
+            seen.add(int(msg.body["id"]))
+            chaos_q.delete(msg.receipt)
+    report.check(
+        len(seen) == sent - chaos_q.dropped,
+        "every non-dropped message delivered despite delay/dup/reorder",
+    )
+    report.check(
+        deliveries >= len(seen), "duplicates deduplicated by consumers"
+    )
+    report.details.update(
+        dropped=chaos_q.dropped,
+        delayed=chaos_q.delayed,
+        duplicated=chaos_q.duplicated,
+        deliveries=deliveries,
+    )
+    return report
+
+
+# --- flaky-rpc ---------------------------------------------------------------
+
+
+def flaky_rpc(seed: int) -> ScenarioReport:
+    """Retryable error bursts against the unified RetryPolicy (jittered,
+    clock-injected, deadline-safe) and a hard outage against the circuit
+    breaker wired into GoogleAuthTransport."""
+    from deeplearning_cfn_tpu.provision.gcp_transport import (
+        GCPAPIError,
+        GoogleAuthTransport,
+    )
+    from deeplearning_cfn_tpu.utils.resilience import CircuitBreaker, CircuitOpen
+
+    report = ScenarioReport("flaky-rpc", seed)
+
+    # -- burst phase: every call must eventually succeed ----------------
+    clock = RecordingClock()
+    opener = FlakyOpener(seed=seed, error_rate=0.45, reset_rate=0.15)
+    transport = GoogleAuthTransport(
+        project="chaos",
+        token_provider=lambda: ("tok", 1e18),
+        opener=opener,
+        max_retries=8,
+        backoff_s=0.05,
+        clock=clock,
+        seed=seed,
+    )
+    calls = 20
+    successes = 0
+    for i in range(calls):
+        try:
+            out = transport("GET", f"projects/p/locations/z/nodes/n{i}", None)
+            successes += 1 if out == {"ok": True} else 0
+        except GCPAPIError:
+            pass
+    report.check(
+        successes == calls,
+        "all calls succeeded through seeded 429/500/503/reset bursts",
+    )
+    base, cap = 0.05, 0.05 * 2**8
+    report.check(
+        all(base <= s <= cap for s in clock.sleeps),
+        "every backoff sleep within jitter bounds [base_s, cap_s]",
+    )
+    report.check(
+        len(set(round(s, 6) for s in clock.sleeps)) > 1
+        if len(clock.sleeps) > 4
+        else True,
+        "backoff is jittered (not a fixed exponential ladder)",
+    )
+    report.check(
+        clock.now() == sum(clock.sleeps),
+        "all waiting happened on the injected clock (no real sleeps)",
+    )
+
+    # -- hard-down phase: the breaker must fail fast --------------------
+    degraded_before = _degraded_event_count()
+    hard_opener = FlakyOpener(seed=seed + 1, hard_down=True)
+    breaker = CircuitBreaker(
+        name="gcp-control-plane",
+        failure_threshold=3,
+        reset_after_s=60.0,
+        clock=clock,
+    )
+    down = GoogleAuthTransport(
+        project="chaos",
+        token_provider=lambda: ("tok", 1e18),
+        opener=hard_opener,
+        max_retries=1,
+        backoff_s=0.01,
+        clock=clock,
+        seed=seed,
+        breaker=breaker,
+    )
+    outcomes: list[str] = []
+    for i in range(6):
+        try:
+            down("GET", f"projects/p/locations/z/nodes/d{i}", None)
+            outcomes.append("ok")
+        except CircuitOpen:
+            outcomes.append("circuit-open")
+        except GCPAPIError:
+            outcomes.append("api-error")
+    requests_when_open = len(hard_opener.requests)
+    report.check(
+        outcomes == ["api-error"] * 3 + ["circuit-open"] * 3,
+        "breaker tripped after 3 consecutive outages, then failed fast",
+    )
+    report.check(
+        requests_when_open == 3 * 2,
+        "no HTTP requests issued while the circuit is open",
+    )
+    report.check(
+        _degraded_event_count() == degraded_before + 1,
+        "breaker trip published a degraded event to the obs plane",
+    )
+    # -- half-open probe ------------------------------------------------
+    clock.advance(61.0)
+    try:
+        down("GET", "projects/p/locations/z/nodes/probe", None)
+        probe_outcome = "ok"
+    except GCPAPIError:
+        probe_outcome = "api-error"
+    except CircuitOpen:
+        probe_outcome = "circuit-open"
+    report.check(
+        probe_outcome == "api-error"
+        and len(hard_opener.requests) == requests_when_open + 2
+        and breaker.state == "open",
+        "after cooldown exactly one probe ran, failed, and re-opened the circuit",
+    )
+    report.details.update(
+        burst_requests=len(opener.requests),
+        retries=len(opener.requests) - calls,
+        backoff_sleeps=len(clock.sleeps),
+        virtual_wait_s=round(sum(clock.sleeps), 6),
+        hard_down_requests=len(hard_opener.requests),
+    )
+    return report
+
+
+# --- slow-disk ---------------------------------------------------------------
+
+
+def slow_disk(seed: int) -> ScenarioReport:
+    """Torn and slow checkpoint writes: the atomic protocol must make
+    torn writes unobservable, and the fallback chain must keep absorbing
+    checkpoints (degrading local -> objectstore) instead of failing."""
+    from deeplearning_cfn_tpu.provision.objectstore import LocalObjectStore
+    from deeplearning_cfn_tpu.train.checkpoint import (
+        FallbackCheckpointer,
+        ObjectStoreCheckpointer,
+        StateCheckpointer,
+    )
+
+    report = ScenarioReport("slow-disk", seed)
+    root = Path(tempfile.mkdtemp(prefix="dlcfn-chaos-"))
+    try:
+        clock = FakeClock()
+        torn = TornDisk(seed=seed, fail_rate=0.6)
+        local = StateCheckpointer(root / "local", io=torn)
+        remote = ObjectStoreCheckpointer(
+            store=LocalObjectStore(root=root / "bucket")
+        )
+        degraded_before = _degraded_event_count()
+        chain = FallbackCheckpointer(
+            tiers=[("local", local), ("objectstore", remote)],
+            failure_threshold=3,
+            reset_after_s=1_000.0,
+            clock=clock,
+        )
+        tiers_used: list[str] = []
+        steps = 12
+        for step in range(1, steps + 1):
+            tiers_used.append(chain.save(step, {"step": step, "loss": 0.5 / step}))
+        report.check(
+            len(tiers_used) == steps,
+            "every checkpoint landed on some tier (no failed saves escaped)",
+        )
+        report.check(torn.torn > 0, "torn writes actually injected")
+        restored = chain.restore_latest()
+        report.check(
+            restored is not None and restored[1] == steps,
+            "restore_latest returns the newest checkpoint across tiers",
+        )
+        report.check(
+            restored is not None and restored[0]["step"] == steps,
+            "restored state is intact (content hash verified)",
+        )
+        # Every checkpoint visible on the local tier must verify: torn
+        # writes may only ever leave temp files, never half a committed
+        # checkpoint.
+        local_ok = all(
+            local.io.read_bytes(local._file(s)) and local.restore_latest()
+            for s in local.steps()
+        )
+        committed = list((root / "local").glob("state-*.json"))
+        temps = list((root / "local").glob(".state-*"))
+        report.check(
+            local_ok and not temps,
+            "no torn bytes observable: committed files verify, temps cleaned",
+        )
+        # Accounting invariant: the local tier's save count equals its
+        # successful writes (attempted minus torn), and everything else
+        # fell through to the objectstore — fallback fires exactly when
+        # the local tier failed or its breaker quarantined it, never
+        # spuriously.
+        report.check(
+            tiers_used.count("local") == torn.writes - torn.torn
+            and tiers_used.count("objectstore")
+            == steps - tiers_used.count("local"),
+            "fallback engaged exactly when the local tier failed or was quarantined",
+        )
+        if chain.breaker("local").state != "closed":
+            report.check(
+                _degraded_event_count() > degraded_before,
+                "local-tier breaker trip published a degraded event",
+            )
+
+        # -- slow disk: latency consumes virtual, not wall, time --------
+        slow = SlowDisk(clock=clock, latency_s=7.0)
+        slow_ck = StateCheckpointer(root / "slow", io=slow)
+        t0 = clock.now()
+        for step in (1, 2, 3):
+            slow_ck.save(step, {"step": step})
+        report.check(
+            clock.now() - t0 == 21.0,
+            "slow-disk latency consumed injected-clock time only",
+        )
+        report.check(
+            slow_ck.restore_latest() == ({"step": 3}, 3),
+            "slow writes still commit atomically and restore cleanly",
+        )
+        report.details.update(
+            tiers_used=tiers_used,
+            torn_writes=torn.torn,
+            total_writes=torn.writes,
+            local_steps=local.steps(),
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return report
+
+
+SCENARIOS: dict[str, Callable[[int], ScenarioReport]] = {
+    "silent-death": silent_death,
+    "partition": partition,
+    "flaky-rpc": flaky_rpc,
+    "slow-disk": slow_disk,
+}
+
+
+def run_scenario(name: str, seed: int = 0) -> ScenarioReport:
+    """Run one named scenario; unknown names list the catalog."""
+    try:
+        fn = SCENARIOS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown chaos scenario {name!r}; available: "
+            f"{sorted(SCENARIOS)}"
+        ) from None
+    return fn(seed)

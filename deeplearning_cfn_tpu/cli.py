@@ -883,6 +883,36 @@ def cmd_lint(args) -> int:
     return 1 if violations else 0
 
 
+def cmd_chaos(args) -> int:
+    """dlcfn chaos: run named fault-injection scenarios (docs/RESILIENCE.md).
+
+    Each scenario drives real components through seeded faults on virtual
+    clocks and asserts recovery invariants; the report is deterministic
+    per (scenario, seed).  Exit 1 if any invariant was violated."""
+    from deeplearning_cfn_tpu.chaos import SCENARIOS, run_scenario
+
+    if args.list_scenarios:
+        for name in sorted(SCENARIOS):
+            doc = (SCENARIOS[name].__doc__ or "").strip().split("\n")[0]
+            print(f"{name:14s} {doc}")
+        return 0
+    names = sorted(SCENARIOS) if args.all else [args.scenario]
+    if names == [None]:
+        print("dlcfn chaos: pass --scenario NAME, --all, or --list")
+        return 2
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        print(
+            f"dlcfn chaos: unknown scenario(s) {unknown}; "
+            f"available: {sorted(SCENARIOS)}"
+        )
+        return 2
+    reports = [run_scenario(name, args.seed) for name in names]
+    payload = [r.to_dict() for r in reports]
+    print(json.dumps(payload[0] if len(payload) == 1 else payload, indent=2))
+    return 0 if all(r.passed for r in reports) else 1
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="dlcfn", description=__doc__.split("\n")[0])
     sub = parser.add_subparsers(dest="command", required=True)
@@ -1053,6 +1083,21 @@ def main(argv: list[str] | None = None) -> int:
                     help="only events of this kind (e.g. span, lifecycle, "
                          "liveness)")
     pe.set_defaults(fn=cmd_events)
+    # chaos runs named fault-injection scenarios against real components.
+    px = sub.add_parser(
+        "chaos", help="run seeded fault-injection scenarios (resilience soak)"
+    )
+    px.add_argument("--scenario", default=None,
+                    help="scenario name (see --list): silent-death, "
+                         "partition, flaky-rpc, slow-disk")
+    px.add_argument("--seed", type=int, default=0,
+                    help="fault-schedule seed; reports are deterministic "
+                         "per (scenario, seed)")
+    px.add_argument("--all", action="store_true",
+                    help="run every scenario in the catalog")
+    px.add_argument("--list", action="store_true", dest="list_scenarios",
+                    help="list scenarios and exit")
+    px.set_defaults(fn=cmd_chaos)
     args = parser.parse_args(argv)
     return args.fn(args)
 

@@ -15,13 +15,19 @@ import json
 import shutil
 import socket
 import subprocess
-import time
 from pathlib import Path
 from typing import Any
 
 from deeplearning_cfn_tpu.cluster.queue import Message, RendezvousQueue
 from deeplearning_cfn_tpu.obs.tracing import span
 from deeplearning_cfn_tpu.utils.logging import get_logger
+from deeplearning_cfn_tpu.utils.resilience import RetryExhausted, RetryPolicy
+from deeplearning_cfn_tpu.utils.timeouts import (
+    BudgetExhausted,
+    Clock,
+    MonotonicClock,
+    TimeoutBudget,
+)
 
 log = get_logger("dlcfn.broker")
 
@@ -46,6 +52,52 @@ BROKER_BIN = BROKER_DIR / "dlcfn-broker"
 
 class BrokerError(RuntimeError):
     pass
+
+
+class BrokerTimeout(BrokerError, TimeoutError):
+    """The broker did not become reachable within the readiness budget."""
+
+    def __init__(self, timeout_s: float, last: BaseException | None = None):
+        super().__init__(
+            f"broker did not become reachable within {timeout_s:.1f}s"
+            + (f" (last error: {last})" if last is not None else "")
+        )
+        self.timeout_s = timeout_s
+        self.last = last
+
+
+def await_broker_ready(
+    probe,
+    timeout_s: float = 5.0,
+    clock: Clock | None = None,
+    poll_interval_s: float = 0.05,
+) -> None:
+    """Poll ``probe()`` until it stops raising OSError, bounded by a
+    monotonic deadline.
+
+    The unified-policy port of the old bare ``time.sleep(0.05)`` loop:
+    attempts draw from one :class:`TimeoutBudget` on an injectable clock,
+    and exhaustion raises the typed :class:`BrokerTimeout` instead of a
+    generic error (callers can distinguish "never came up" from protocol
+    failures).
+    """
+    clock = clock or MonotonicClock()
+    policy = RetryPolicy(
+        # The budget is the real bound; size the attempt ceiling so the
+        # policy can never give up before the deadline does.
+        max_attempts=max(2, int(timeout_s / max(poll_interval_s, 1e-6)) + 1),
+        base_s=poll_interval_s,
+        cap_s=max(poll_interval_s * 5, poll_interval_s),
+        clock=clock,
+        seed=0,
+        retryable=(OSError,),
+    )
+    budget = TimeoutBudget(timeout_s, clock=clock)
+    try:
+        policy.call(probe, budget=budget, phase="broker-ready")
+    except (BudgetExhausted, RetryExhausted) as err:
+        last = getattr(err, "last", None) or err
+        raise BrokerTimeout(timeout_s, last) from err
 
 
 class BrokerConnection:
@@ -279,7 +331,13 @@ class BrokerProcess:
     ``token``: spawn the broker with AUTH required (via env, never argv —
     /proc cmdline is world-readable)."""
 
-    def __init__(self, port: int = 0, token: str | None = None):
+    def __init__(
+        self,
+        port: int = 0,
+        token: str | None = None,
+        ready_timeout_s: float = 5.0,
+        clock: Clock | None = None,
+    ):
         import os
 
         build_broker()
@@ -300,15 +358,18 @@ class BrokerProcess:
         if "listening on" not in line:
             raise BrokerError(f"broker failed to start: {line!r}")
         self.port = int(line.strip().rsplit(" ", 1)[-1])
-        # Wait until accepting.
-        deadline = time.monotonic() + 5.0
-        while time.monotonic() < deadline:
+
+        # Wait until accepting, on a monotonic budget with a typed
+        # timeout (BrokerTimeout) instead of the old unbounded-feeling
+        # bare-sleep spin.
+        def _probe() -> None:
+            conn = BrokerConnection("127.0.0.1", self.port, timeout_s=1.0)
             try:
-                BrokerConnection("127.0.0.1", self.port, timeout_s=1.0).ping()
-                return
-            except OSError:
-                time.sleep(0.05)
-        raise BrokerError("broker did not become reachable")
+                conn.ping()
+            finally:
+                conn.close()
+
+        await_broker_ready(_probe, timeout_s=ready_timeout_s, clock=clock)
 
     def queue(self, name: str) -> BrokerQueue:
         return BrokerQueue(name, "127.0.0.1", self.port, token=self.token)

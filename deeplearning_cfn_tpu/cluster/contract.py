@@ -26,6 +26,8 @@ import shlex
 from dataclasses import dataclass, field, asdict
 from pathlib import Path
 
+from deeplearning_cfn_tpu.utils.atomicio import atomic_write_text
+
 COORDINATOR_HOSTNAME = "deeplearning-master"
 WORKER_HOSTNAME_FMT = "deeplearning-worker{index}"
 DEFAULT_COORDINATOR_PORT = 8476
@@ -212,20 +214,26 @@ class ClusterContract:
         return self.root_dir() / "workers"
 
     def write(self, root: Path | None = None) -> Path:
+        # Atomic per file: on-VM agents read these while the coordinator
+        # (re)publishes them — a torn contract.json must be impossible.
         root = root or self.root_dir()
         root.mkdir(parents=True, exist_ok=True)
-        (root / "workers").write_text(
-            "".join(f"{h}\n" for h in self.hostnames())
+        atomic_write_text(
+            root / "workers", "".join(f"{h}\n" for h in self.hostnames())
         )
-        (root / "hosts").write_text(
-            "".join(f"{ip} {host}\n" for ip, host in self.hosts_entries())
+        atomic_write_text(
+            root / "hosts",
+            "".join(f"{ip} {host}\n" for ip, host in self.hosts_entries()),
         )
-        (root / "env.sh").write_text(
+        atomic_write_text(
+            root / "env.sh",
             "".join(
                 f"export {k}={shlex.quote(v)}\n" for k, v in self.env(root).items()
-            )
+            ),
         )
-        (root / "contract.json").write_text(json.dumps(asdict(self), indent=2))
+        atomic_write_text(
+            root / "contract.json", json.dumps(asdict(self), indent=2)
+        )
         return root
 
     @classmethod

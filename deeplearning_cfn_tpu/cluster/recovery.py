@@ -34,6 +34,7 @@ from deeplearning_cfn_tpu.obs.tracing import span
 from deeplearning_cfn_tpu.provision.events import LifecycleEvent
 from deeplearning_cfn_tpu.provision.provisioner import ProvisionResult, Provisioner
 from deeplearning_cfn_tpu.utils.logging import get_logger
+from deeplearning_cfn_tpu.utils.resilience import RetryPolicy
 
 log = get_logger("dlcfn.recovery")
 
@@ -83,6 +84,7 @@ def run_with_recovery(
     provisioner: Provisioner,
     train_once: Callable[[ProvisionResult], dict],
     max_recoveries: int = 1,
+    policy: RetryPolicy | None = None,
 ) -> tuple[dict, ProvisionResult, int]:
     """provision → train → (on loss: recover → resume) loop.
 
@@ -91,11 +93,19 @@ def run_with_recovery(
     (and for restoring, which makes resumption automatic).  Returns the
     last episode's metrics, the final provision result, and how many
     recoveries happened.
+
+    ``policy`` (a :class:`~..utils.resilience.RetryPolicy`) adds jittered
+    backoff between recovery attempts on the policy's injected clock —
+    back-to-back recreates against a struggling control plane are the
+    same thundering-herd mistake as unjittered RPC retries.  The give-up
+    bound stays ``max_recoveries``; the default (no policy) recovers
+    immediately, as before.
     """
     result = provisioner.provision()
     manager = RecoveryManager(provisioner)
     manager.attach(result)
     recoveries = 0
+    delays = policy.delays() if policy is not None else None
     while True:
         out = train_once(result)
         if not manager.needs_recovery:
@@ -106,4 +116,8 @@ def run_with_recovery(
                 f"(pending: {[e.instance_id for e in manager.losses]})"
             )
         recoveries += 1
+        if delays is not None and policy is not None:
+            backoff = next(delays)
+            get_recorder().record("recovery_backoff", delay_s=backoff)
+            policy.clock.sleep(backoff)
         result = manager.recover()

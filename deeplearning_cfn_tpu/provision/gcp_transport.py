@@ -41,6 +41,13 @@ from dataclasses import dataclass, field
 from typing import Callable
 
 from deeplearning_cfn_tpu.utils.logging import get_logger
+from deeplearning_cfn_tpu.utils.resilience import (
+    CircuitBreaker,
+    CircuitOpen,
+    RetryExhausted,
+    RetryPolicy,
+)
+from deeplearning_cfn_tpu.utils.timeouts import Clock, MonotonicClock
 
 log = get_logger("dlcfn.gcp.transport")
 
@@ -104,8 +111,27 @@ class GoogleAuthTransport:
     max_retries: int = 4
     backoff_s: float = 1.0
     timeout_s: float = 60.0
+    # Injectable seams for resilience: the clock the retry policy sleeps
+    # against (chaos tests pass FakeClock so flaky-RPC soaks run in
+    # microseconds), the jitter seed (None -> nondeterministic, which is
+    # what production wants), and an optional circuit breaker shared by
+    # the backend so a hard-down control plane fails fast instead of
+    # burning the full retry schedule on every call.
+    clock: Clock = field(default_factory=MonotonicClock)
+    seed: int | None = None
+    breaker: CircuitBreaker | None = None
     _token: str | None = field(default=None, repr=False)
     _token_expiry: float = 0.0
+
+    def __post_init__(self) -> None:
+        self._policy = RetryPolicy(
+            max_attempts=self.max_retries + 1,
+            base_s=self.backoff_s,
+            cap_s=max(self.backoff_s, self.backoff_s * (2**self.max_retries)),
+            clock=self.clock,
+            seed=self.seed,
+            classify=self._classify,
+        )
 
     # -- auth ------------------------------------------------------------
     def _access_token(self) -> str:
@@ -142,10 +168,28 @@ class GoogleAuthTransport:
         raise ValueError(f"unroutable GCP path: {path!r}")
 
     # -- the call --------------------------------------------------------
+    @staticmethod
+    def _classify(exc: BaseException) -> bool | None:
+        """Retry 401 (token refresh) and transient statuses; 404/4xx are
+        answers, not failures.  Raw URLError = connection-level trouble."""
+        if isinstance(exc, GCPAPIError):
+            return exc.status == 401 or exc.status in RETRYABLE_STATUS
+        if isinstance(exc, urllib.error.URLError):
+            return True
+        return False
+
+    @staticmethod
+    def _is_outage(exc: BaseException) -> bool:
+        """Breaker bookkeeping: only unreachability counts against the
+        circuit.  A 403 or 404 means the control plane answered."""
+        if isinstance(exc, GCPAPIError):
+            return exc.status == 0 or exc.status in RETRYABLE_STATUS
+        return isinstance(exc, urllib.error.URLError)
+
     def __call__(self, method: str, path: str, body: dict | None) -> dict:
         url, payload, ctype = self.resolve(method, path, body)
-        last_err: Exception | None = None
-        for attempt in range(self.max_retries + 1):
+
+        def _attempt() -> dict:
             req = urllib.request.Request(
                 url,
                 data=payload,
@@ -172,28 +216,52 @@ class GoogleAuthTransport:
                     pass
                 if err.code == 404:
                     raise KeyError(path) from None
-                if err.code == 401 and attempt < self.max_retries:
-                    # Token may have been revoked/expired early: refresh once
-                    # per attempt rather than failing the whole operation.
+                if err.code == 401:
+                    # Token may have been revoked/expired early: drop it so
+                    # the next attempt re-authenticates instead of replaying
+                    # the dead credential.
                     self._token = None
-                    last_err = GCPAPIError(err.code, path, detail)
-                elif err.code in RETRYABLE_STATUS and attempt < self.max_retries:
-                    last_err = GCPAPIError(err.code, path, detail)
-                else:
-                    raise GCPAPIError(err.code, path, detail) from None
-            except urllib.error.URLError as err:
-                if attempt >= self.max_retries:
-                    raise GCPAPIError(0, path, str(err.reason)) from None
-                last_err = err
-            sleep = self.backoff_s * (2**attempt)
+                raise GCPAPIError(err.code, path, detail) from None
+
+        def _on_retry(attempt: int, delay: float, exc: BaseException) -> None:
             log.warning(
-                "retrying %s %s in %.1fs (attempt %d/%d): %s",
+                "retrying %s %s in %.3fs (attempt %d/%d): %s",
                 method,
                 path,
-                sleep,
-                attempt + 1,
-                self.max_retries,
-                last_err,
+                delay,
+                attempt,
+                self.max_retries + 1,
+                exc,
             )
-            time.sleep(sleep)
-        raise GCPAPIError(0, path, f"retries exhausted: {last_err}")
+
+        def _run() -> dict:
+            try:
+                return self._policy.call(
+                    _attempt, phase=f"{method} {path}", on_retry=_on_retry
+                )
+            except RetryExhausted as exhausted:
+                last = exhausted.last
+                if isinstance(last, GCPAPIError):
+                    raise last from exhausted
+                if isinstance(last, urllib.error.URLError):
+                    raise GCPAPIError(0, path, str(last.reason)) from exhausted
+                raise GCPAPIError(
+                    0, path, f"retries exhausted: {last}"
+                ) from exhausted
+
+        if self.breaker is None:
+            return _run()
+        if not self.breaker.allow():
+            raise CircuitOpen(
+                self.breaker.name, self.breaker.consecutive_failures
+            )
+        try:
+            result = _run()
+        except BaseException as exc:
+            if self._is_outage(exc):
+                self.breaker.record_failure()
+            else:
+                self.breaker.record_success()
+            raise
+        self.breaker.record_success()
+        return result

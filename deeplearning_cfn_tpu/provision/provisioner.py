@@ -22,7 +22,7 @@ rollback analog).
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable
 
@@ -35,6 +35,7 @@ from deeplearning_cfn_tpu.cluster.contract import ClusterContract
 from deeplearning_cfn_tpu.cluster.elasticity import ElasticityController, GroupPolicy
 from deeplearning_cfn_tpu.config.schema import ClusterSpec, ConfigError, NodePool
 from deeplearning_cfn_tpu.provision.backend import Backend, ResourceSignal, StorageHandle
+from deeplearning_cfn_tpu.utils.atomicio import atomic_write_text
 from deeplearning_cfn_tpu.utils.logging import get_logger
 from deeplearning_cfn_tpu.utils.timeouts import BudgetExhausted, TimeoutBudget
 
@@ -65,6 +66,9 @@ class ProvisionResult:
     controller: ElasticityController
     degraded: bool
     job_violation: str | None = None
+    # Slices that failed bring-up but were tolerated under min_slices:
+    # the cluster is live and smaller, not failed (graceful degradation).
+    degraded_slices: list[str] = field(default_factory=list)
 
     @property
     def realized_workers(self) -> int:
@@ -223,12 +227,34 @@ class Provisioner:
                     "deployment so on-VM agents prove readiness"
                 )
             contract = self._run_bootstrap(coord_q, worker_q)
+        # Non-coordinator slices that rendered FAILURE but were tolerated
+        # under min_slices: mark them degraded (journaled, queryable on the
+        # result) instead of failing the whole bring-up.
+        degraded_slices = [
+            g
+            for g in self.group_names
+            if self.backend.get_resource_signal(f"group:{g}")
+            is ResourceSignal.FAILURE
+        ]
+        if degraded_slices:
+            from deeplearning_cfn_tpu.obs.recorder import get_recorder
+
+            for g in degraded_slices:
+                get_recorder().record(
+                    "slice_degraded", cluster=spec.name, group=g
+                )
+            log.warning(
+                "cluster %s came up degraded: slice(s) %s below minimum",
+                spec.name,
+                degraded_slices,
+            )
         result = ProvisionResult(
             spec=spec,
             contract=contract,
             storage=self._storage,
             controller=controller,
-            degraded=contract.degraded,
+            degraded=contract.degraded or bool(degraded_slices),
+            degraded_slices=degraded_slices,
         )
         if result.degraded:
             # A shrunken cluster can violate job invariants the original
@@ -530,7 +556,10 @@ class Provisioner:
             return
         path = self._storage_record_path()
         path.parent.mkdir(parents=True, exist_ok=True)
-        path.write_text(
+        # Atomic: recover() in a fresh process must never read a torn
+        # record — that would silently abandon retained storage.
+        atomic_write_text(
+            path,
             json.dumps(
                 {
                     "cluster": self.spec.name,
@@ -539,7 +568,7 @@ class Provisioner:
                     "mount_point": self._storage.mount_point,
                     "retain_on_delete": self._storage.retain_on_delete,
                 }
-            )
+            ),
         )
 
     def _read_storage_record(self) -> str | None:

@@ -20,15 +20,20 @@ TPU-native equivalents here:
 
 from __future__ import annotations
 
+import hashlib
+import json
+import os
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Any
+from typing import Any, Sequence
 
 import jax
 import orbax.checkpoint as ocp
 
 from deeplearning_cfn_tpu.utils.logging import get_logger
+from deeplearning_cfn_tpu.utils.resilience import CircuitBreaker
+from deeplearning_cfn_tpu.utils.timeouts import Clock, MonotonicClock
 
 log = get_logger("dlcfn.checkpoint")
 
@@ -127,3 +132,259 @@ class Checkpointer:
     def close(self) -> None:
         self.wait()
         self._manager.close()
+
+
+# --- resilient control-plane checkpointing (orbax-free) ---------------------
+#
+# The classes below checkpoint small JSON-serializable state (trainer
+# progress markers, controller bookkeeping) with the durability story the
+# chaos suite exercises: every write is atomic (write-temp -> fsync ->
+# rename), every restore verifies a content hash, and the
+# FallbackCheckpointer degrades local -> object store behind per-tier
+# circuit breakers instead of failing the run on the first bad disk.
+
+
+class CheckpointIO:
+    """Filesystem seam for checkpoint bytes; chaos injectors (TornDisk,
+    SlowDisk in chaos/injectors.py) subclass this to corrupt or delay the
+    raw write while the atomic rename protocol above it stays honest."""
+
+    def write_bytes(self, path: Path, data: bytes) -> None:
+        with open(path, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+
+    def replace(self, src: Path, dst: Path) -> None:
+        os.replace(src, dst)
+
+    def read_bytes(self, path: Path) -> bytes:
+        return path.read_bytes()
+
+
+class CheckpointWriteError(OSError):
+    """No checkpoint tier accepted the write."""
+
+
+def _envelope(step: int, state: dict) -> bytes:
+    from deeplearning_cfn_tpu.train.metrics import json_safe
+
+    body = json.dumps(json_safe(state), sort_keys=True, allow_nan=False)
+    return json.dumps(
+        {
+            "step": step,
+            "sha256": hashlib.sha256(body.encode()).hexdigest(),
+            "state": json.loads(body),
+        },
+        allow_nan=False,
+    ).encode()
+
+
+def _open_envelope(raw: bytes) -> tuple[dict, int] | None:
+    """Parse + verify an envelope; None for torn/corrupt bytes."""
+    try:
+        env = json.loads(raw.decode())
+        body = json.dumps(env["state"], sort_keys=True, allow_nan=False)
+        if hashlib.sha256(body.encode()).hexdigest() != env["sha256"]:
+            return None
+        return env["state"], int(env["step"])
+    except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+        return None
+
+
+@dataclass
+class StateCheckpointer:
+    """Atomic JSON checkpoints: ``state-<step>.json`` written temp-first.
+
+    The rename is the commit point — a writer dying (or a TornDisk
+    raising) mid-write leaves only a dot-prefixed temp file that
+    ``steps()`` never globs, so ``restore_latest`` cannot observe a
+    half-written checkpoint.  The sha256 in the envelope is defense in
+    depth against corruption below the rename (bit rot, lying disks).
+    """
+
+    directory: str | Path
+    max_to_keep: int = 3
+    io: CheckpointIO = field(default_factory=CheckpointIO)
+
+    def __post_init__(self) -> None:
+        self._dir = Path(self.directory).absolute()
+        self._dir.mkdir(parents=True, exist_ok=True)
+
+    def _file(self, step: int) -> Path:
+        return self._dir / f"state-{step:08d}.json"
+
+    def steps(self) -> list[int]:
+        out = []
+        for p in self._dir.glob("state-*.json"):
+            try:
+                out.append(int(p.stem.split("-", 1)[1]))
+            except (IndexError, ValueError):
+                continue
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, state: dict) -> Path:
+        final = self._file(step)
+        tmp = self._dir / f".{final.name}.tmp-{os.getpid()}"
+        try:
+            self.io.write_bytes(tmp, _envelope(step, state))
+            self.io.replace(tmp, final)
+        finally:
+            # A torn write must not litter: the temp either renamed away
+            # or gets unlinked here, leaving the directory canonical.
+            if tmp.exists():
+                tmp.unlink(missing_ok=True)
+        self._gc()
+        return final
+
+    def restore_latest(self) -> tuple[dict, int] | None:
+        """Newest verifiable checkpoint, skipping any that fail the hash."""
+        for step in reversed(self.steps()):
+            try:
+                raw = self.io.read_bytes(self._file(step))
+            except OSError:
+                continue
+            opened = _open_envelope(raw)
+            if opened is not None:
+                return opened
+            log.warning(
+                "checkpoint step %d failed verification; skipping", step
+            )
+        return None
+
+    def _gc(self) -> None:
+        steps = self.steps()
+        for stale in steps[: -self.max_to_keep]:
+            self._file(stale).unlink(missing_ok=True)
+
+
+@dataclass
+class ObjectStoreCheckpointer:
+    """The same envelope protocol against an ObjectStore (GCS in
+    production, LocalObjectStore under test).  Object stores commit
+    whole objects, so the put itself is the atomic rename."""
+
+    store: Any  # ObjectStore protocol: put/get/list
+    prefix: str = "checkpoints"
+
+    def _key(self, step: int) -> str:
+        return f"{self.prefix}/state-{step:08d}.json"
+
+    def steps(self) -> list[int]:
+        out = []
+        for key in self.store.list(self.prefix):
+            name = key.rsplit("/", 1)[-1]
+            if name.startswith("state-") and name.endswith(".json"):
+                try:
+                    out.append(int(name[len("state-") : -len(".json")]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, state: dict) -> str:
+        key = self._key(step)
+        self.store.put(key, _envelope(step, state))
+        return key
+
+    def restore_latest(self) -> tuple[dict, int] | None:
+        for step in reversed(self.steps()):
+            try:
+                raw = self.store.get(self._key(step))
+            except (OSError, KeyError):
+                continue
+            opened = _open_envelope(bytes(raw))
+            if opened is not None:
+                return opened
+        return None
+
+
+@dataclass
+class FallbackCheckpointer:
+    """Graceful degradation across checkpoint tiers (local, then object
+    store): each tier sits behind its own circuit breaker, a failed write
+    falls through to the next tier instead of failing the run, and the
+    first open breaker marks the chain degraded (visible in the flight
+    journal via the breaker's ``degraded`` event)."""
+
+    tiers: Sequence[tuple[str, Any]]
+    failure_threshold: int = 3
+    reset_after_s: float = 60.0
+    clock: Clock = field(default_factory=MonotonicClock)
+
+    def __post_init__(self) -> None:
+        if not self.tiers:
+            raise ValueError("FallbackCheckpointer needs at least one tier")
+        self._breakers = {
+            name: CircuitBreaker(
+                name=f"checkpoint.{name}",
+                failure_threshold=self.failure_threshold,
+                reset_after_s=self.reset_after_s,
+                clock=self.clock,
+            )
+            for name, _ in self.tiers
+        }
+        self.last_save_tier: str | None = None
+
+    @property
+    def degraded(self) -> bool:
+        return any(b.state != "closed" for b in self._breakers.values())
+
+    def breaker(self, name: str) -> CircuitBreaker:
+        return self._breakers[name]
+
+    def save(self, step: int, state: dict) -> str:
+        """Write to the first healthy tier; returns the tier name used."""
+        last_err: BaseException | None = None
+        for name, tier in self.tiers:
+            breaker = self._breakers[name]
+            if not breaker.allow():
+                continue
+            try:
+                tier.save(step, state)
+            except Exception as exc:
+                breaker.record_failure()
+                last_err = exc
+                log.warning(
+                    "checkpoint tier %r failed at step %d: %s", name, step, exc
+                )
+                continue
+            breaker.record_success()
+            if name != self.tiers[0][0]:
+                self._record_fallback(name, step)
+            self.last_save_tier = name
+            return name
+        raise CheckpointWriteError(
+            f"no checkpoint tier accepted step {step} (last error: {last_err})"
+        )
+
+    def restore_latest(self) -> tuple[dict, int] | None:
+        """Newest verifiable checkpoint across all tiers (a degraded run
+        may have its freshest state on the fallback tier)."""
+        best: tuple[dict, int] | None = None
+        for name, tier in self.tiers:
+            try:
+                found = tier.restore_latest()
+            except Exception as exc:
+                log.warning("checkpoint tier %r restore failed: %s", name, exc)
+                continue
+            if found is not None and (best is None or found[1] > best[1]):
+                best = found
+        return best
+
+    def _record_fallback(self, tier: str, step: int) -> None:
+        try:
+            from deeplearning_cfn_tpu.obs.recorder import get_recorder
+
+            get_recorder().record(
+                "checkpoint_fallback", tier=tier, step=step
+            )
+        except Exception:  # pragma: no cover - journaling is best-effort
+            pass

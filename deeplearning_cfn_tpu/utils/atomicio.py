@@ -1,0 +1,33 @@
+"""Atomic small-file writes: write-temp -> fsync -> rename.
+
+Control-plane records (cluster contract, storage binding, checkpoints)
+are read by *other* processes, possibly while the writer is being
+killed — a torn ``write_text`` would hand the reader half a JSON
+document.  ``os.replace`` on the same filesystem is atomic, so the
+reader sees either the old complete file or the new complete file,
+never a prefix.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+def atomic_write_bytes(path: str | Path, data: bytes) -> Path:
+    path = Path(path)
+    tmp = path.parent / f".{path.name}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    finally:
+        if tmp.exists():
+            tmp.unlink(missing_ok=True)
+    return path
+
+
+def atomic_write_text(path: str | Path, text: str) -> Path:
+    return atomic_write_bytes(path, text.encode())

@@ -12,6 +12,11 @@ echo "== dlcfn lint (full: --concurrency --protocol, baselined) =="
 python -m deeplearning_cfn_tpu.cli lint --concurrency --protocol \
   --baseline scripts/lint_baseline.json || exit 1
 
+echo "== chaos scenarios (seeded, virtual-clock — docs/RESILIENCE.md) =="
+JAX_PLATFORMS=cpu python -m deeplearning_cfn_tpu.cli chaos --all --seed 0 \
+  > /tmp/_chaos.json || { cat /tmp/_chaos.json; exit 1; }
+echo "chaos: all scenarios held their invariants (report: /tmp/_chaos.json)"
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -1,0 +1,164 @@
+"""Chaos layer regression harness: scenarios, injectors, CLI, atomicity.
+
+The scenarios themselves are the heavy assertions (they drive real
+components through seeded faults and check recovery invariants); these
+tests pin that every catalog entry passes, that reports are byte-stable
+per seed, and that the seams the injectors rely on keep their contracts.
+All of it runs on virtual clocks — wall time here is import time.
+"""
+
+import json
+
+import pytest
+
+from deeplearning_cfn_tpu.chaos import (
+    SCENARIOS,
+    ChaosQueue,
+    FlakyOpener,
+    TornDisk,
+    run_scenario,
+)
+from deeplearning_cfn_tpu.cluster.queue import InMemoryQueue
+from deeplearning_cfn_tpu.utils.timeouts import FakeClock
+
+ALL = sorted(SCENARIOS)
+
+
+# --- the catalog -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ALL)
+@pytest.mark.parametrize("seed", [0, 1])
+def test_scenario_invariants_hold(name, seed):
+    report = run_scenario(name, seed)
+    assert report.passed, f"{name} seed={seed}: {report.violations}"
+    assert report.invariants  # a passing report must have proved something
+    assert not report.violations
+
+
+@pytest.mark.parametrize("name", ALL)
+def test_scenario_reports_deterministic_per_seed(name):
+    first = run_scenario(name, seed=0).to_dict()
+    second = run_scenario(name, seed=0).to_dict()
+    assert first == second
+    # JSON-stable too: the CLI prints these, CI diffs them.
+    assert json.dumps(first, sort_keys=True) == json.dumps(second, sort_keys=True)
+
+
+def test_unknown_scenario_names_the_catalog():
+    with pytest.raises(KeyError, match="flaky-rpc"):
+        run_scenario("split-brain", 0)
+
+
+# --- CLI ---------------------------------------------------------------------
+
+
+def test_cli_chaos_runs_a_scenario(capsys):
+    from deeplearning_cfn_tpu.cli import main
+
+    assert main(["chaos", "--scenario", "flaky-rpc", "--seed", "1"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["scenario"] == "flaky-rpc"
+    assert report["seed"] == 1
+    assert report["passed"] is True
+
+
+def test_cli_chaos_list_and_bad_name(capsys):
+    from deeplearning_cfn_tpu.cli import main
+
+    assert main(["chaos", "--list"]) == 0
+    out = capsys.readouterr().out
+    for name in ALL:
+        assert name in out
+    assert main(["chaos", "--scenario", "nope"]) == 2
+
+
+# --- injector seam contracts -------------------------------------------------
+
+
+def test_flaky_opener_is_seed_deterministic():
+    def roll(seed):
+        opener = FlakyOpener(seed=seed, error_rate=0.5, reset_rate=0.2)
+        out = []
+        for _ in range(30):
+            try:
+                opener("req")
+                out.append("ok")
+            except Exception as exc:
+                out.append(type(exc).__name__)
+        return out
+
+    assert roll(4) == roll(4)
+    assert roll(4) != roll(5)
+    assert "ok" in roll(4) and "HTTPError" in roll(4)
+
+
+def test_chaos_queue_delay_is_operation_deterministic():
+    clock = FakeClock()
+    q = ChaosQueue(
+        InMemoryQueue("t", clock=clock), seed=0, delay_rate=1.0, delay_ops=2
+    )
+    q.send({"id": 1})
+    assert q.delayed == 1
+    assert q.receive() == []          # op 2: not due yet
+    got = q.receive()                 # op 3: released
+    assert [m.body["id"] for m in got] == [1]
+
+
+def test_chaos_queue_flush_held_drains_everything():
+    clock = FakeClock()
+    q = ChaosQueue(
+        InMemoryQueue("t", clock=clock), seed=0, delay_rate=1.0, delay_ops=100
+    )
+    for i in range(5):
+        q.send({"id": i})
+    assert q.flush_held() == 5
+    seen = {m.body["id"] for m in q.receive(max_messages=10)}
+    assert seen == set(range(5))
+
+
+def test_torn_disk_checkpoint_never_observable(tmp_path):
+    from deeplearning_cfn_tpu.train.checkpoint import StateCheckpointer
+
+    torn = TornDisk(seed=0, fail_rate=0.7)
+    ck = StateCheckpointer(tmp_path, max_to_keep=100, io=torn)
+    landed = []
+    for step in range(1, 21):
+        try:
+            ck.save(step, {"step": step})
+            landed.append(step)
+        except OSError:
+            pass
+    assert torn.torn > 0 and landed  # both outcomes actually exercised
+    # Only committed steps are visible; every one of them verifies.
+    assert ck.steps() == landed
+    state, step = ck.restore_latest()
+    assert step == landed[-1] and state == {"step": step}
+    # The torn temps never litter the directory or the glob.
+    assert not list(tmp_path.glob(".state-*"))
+
+
+def test_atomic_write_survives_interrupted_replace(tmp_path):
+    from deeplearning_cfn_tpu.utils.atomicio import atomic_write_bytes
+
+    target = tmp_path / "contract.json"
+    atomic_write_bytes(target, b"v1")
+    # A crash between write and rename must leave the old contents intact:
+    # simulate by writing the temp then never renaming (the temp cleanup
+    # in the chaos seam mirrors this).
+    tmp = tmp_path / ".contract.json.tmp-999"
+    tmp.write_bytes(b"half-written garb")
+    assert target.read_bytes() == b"v1"
+    atomic_write_bytes(target, b"v2")
+    assert target.read_bytes() == b"v2"
+
+
+# --- soak (excluded from tier-1 by the slow mark) ---------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(5))
+def test_chaos_soak_all_scenarios(seed):
+    for name in ALL:
+        report = run_scenario(name, seed)
+        assert report.passed, f"{name} seed={seed}: {report.violations}"

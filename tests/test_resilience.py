@@ -1,0 +1,296 @@
+"""Units for the unified resilience layer (utils/resilience.py).
+
+Everything runs on FakeClock — a real sleep in any of these paths is a
+regression (the chaos soaks depend on virtual time to run in
+microseconds)."""
+
+import pytest
+
+from deeplearning_cfn_tpu.cluster.broker_client import (
+    BrokerTimeout,
+    await_broker_ready,
+)
+from deeplearning_cfn_tpu.utils.resilience import (
+    CircuitBreaker,
+    CircuitOpen,
+    Fatal,
+    RetryExhausted,
+    RetryPolicy,
+    Retryable,
+)
+from deeplearning_cfn_tpu.utils.timeouts import (
+    BudgetExhausted,
+    FakeClock,
+    TimeoutBudget,
+)
+
+
+class RecordingClock(FakeClock):
+    def __init__(self, start: float = 0.0):
+        super().__init__(start)
+        self.sleeps: list[float] = []
+
+    def sleep(self, seconds: float) -> None:
+        self.sleeps.append(seconds)
+        super().sleep(seconds)
+
+
+# --- RetryPolicy: backoff shape ---------------------------------------------
+
+
+def test_delays_within_jitter_bounds():
+    policy = RetryPolicy(base_s=0.1, cap_s=2.0, seed=7)
+    gen = policy.delays()
+    prev = policy.base_s
+    for _ in range(200):
+        d = next(gen)
+        assert policy.base_s <= d <= policy.cap_s
+        # Decorrelated: each delay is bounded by triple the previous one.
+        assert d <= min(policy.cap_s, prev * 3) + 1e-12
+        prev = d
+
+
+def test_delays_are_jittered_not_a_fixed_ladder():
+    policy = RetryPolicy(base_s=0.1, cap_s=100.0, seed=3)
+    gen = policy.delays()
+    ds = [next(gen) for _ in range(20)]
+    assert len(set(ds)) > 10  # a deterministic 2**n ladder would repeat/shape
+
+
+def test_delays_deterministic_per_seed():
+    def take(seed):
+        gen = RetryPolicy(seed=seed).delays()
+        return [next(gen) for _ in range(10)]
+
+    assert take(5) == take(5)
+    assert take(5) != take(6)
+
+
+# --- RetryPolicy: the loop ---------------------------------------------------
+
+
+def test_call_retries_then_succeeds_on_fake_clock():
+    clock = RecordingClock()
+    policy = RetryPolicy(max_attempts=5, base_s=0.01, cap_s=1.0, clock=clock, seed=0)
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise Retryable("transient")
+        return "ok"
+
+    assert policy.call(flaky) == "ok"
+    assert len(attempts) == 3
+    assert len(clock.sleeps) == 2  # no sleep after the success
+    assert all(0.01 <= s <= 1.0 for s in clock.sleeps)
+
+
+def test_call_exhaustion_raises_typed_error_with_cause():
+    policy = RetryPolicy(max_attempts=3, base_s=0.0, cap_s=0.0, clock=FakeClock(), seed=0)
+    boom = ConnectionError("down")
+    with pytest.raises(RetryExhausted) as err:
+        policy.call(lambda: (_ for _ in ()).throw(boom))
+    assert err.value.attempts == 3
+    assert err.value.last is boom
+    assert err.value.__cause__ is boom
+
+
+def test_fatal_propagates_immediately():
+    calls = []
+
+    def fatal():
+        calls.append(1)
+        raise Fatal("permanent")
+
+    policy = RetryPolicy(max_attempts=5, clock=FakeClock(), seed=0)
+    with pytest.raises(Fatal):
+        policy.call(fatal)
+    assert len(calls) == 1
+
+
+def test_classify_callback_overrides_type_tuples():
+    # ValueError is not in DEFAULT_RETRYABLE, but classify says retry.
+    clock = FakeClock()
+    policy = RetryPolicy(
+        max_attempts=2,
+        base_s=0.0,
+        cap_s=0.0,
+        clock=clock,
+        seed=0,
+        classify=lambda exc: isinstance(exc, ValueError) or None,
+    )
+    with pytest.raises(RetryExhausted):
+        policy.call(lambda: (_ for _ in ()).throw(ValueError("odd")))
+    # ...and classify=False makes a normally-retryable error fatal.
+    policy = RetryPolicy(
+        max_attempts=5, clock=clock, seed=0, classify=lambda exc: False
+    )
+    with pytest.raises(ConnectionError):
+        policy.call(lambda: (_ for _ in ()).throw(ConnectionError("x")))
+
+
+def test_on_retry_hook_sees_attempt_delay_and_cause():
+    clock = FakeClock()
+    seen = []
+    policy = RetryPolicy(max_attempts=3, base_s=0.01, cap_s=1.0, clock=clock, seed=0)
+
+    def flaky():
+        if len(seen) < 1:
+            raise Retryable("once")
+        return 42
+
+    assert policy.call(flaky, on_retry=lambda a, d, e: seen.append((a, d, str(e)))) == 42
+    assert len(seen) == 1
+    attempt, delay, cause = seen[0]
+    assert attempt == 1 and 0.01 <= delay <= 1.0 and cause == "once"
+
+
+# --- RetryPolicy x TimeoutBudget ---------------------------------------------
+
+
+def test_budget_exhaustion_wins_over_remaining_attempts():
+    clock = FakeClock()
+    budget = TimeoutBudget(1.0, clock=clock)
+    policy = RetryPolicy(max_attempts=100, base_s=0.4, cap_s=0.5, clock=clock, seed=0)
+    attempts = []
+
+    def failing():
+        attempts.append(1)
+        raise Retryable("still down")
+
+    with pytest.raises(BudgetExhausted) as err:
+        policy.call(failing, budget=budget, phase="bring-up")
+    assert err.value.phase == "bring-up"
+    # Far fewer than 100 attempts: the 1s budget starved the loop.
+    assert 1 < len(attempts) < 100
+
+
+def test_budget_exhausted_is_never_swallowed_as_retryable():
+    # BudgetExhausted subclasses TimeoutError (which IS retryable); the
+    # policy must still let it propagate from inside fn.
+    clock = FakeClock()
+    policy = RetryPolicy(max_attempts=5, clock=clock, seed=0)
+    with pytest.raises(BudgetExhausted):
+        policy.call(
+            lambda: (_ for _ in ()).throw(BudgetExhausted("p", 1.0))
+        )
+
+
+def test_budget_sleeps_consume_the_budget_not_the_wall():
+    clock = RecordingClock()
+    budget = TimeoutBudget(10.0, clock=clock)
+    policy = RetryPolicy(max_attempts=3, base_s=0.5, cap_s=0.5, clock=clock, seed=0)
+    with pytest.raises(RetryExhausted):
+        policy.call(lambda: (_ for _ in ()).throw(Retryable("x")), budget=budget)
+    assert clock.now() == pytest.approx(sum(clock.sleeps))
+    assert budget.remaining_s == pytest.approx(10.0 - sum(clock.sleeps))
+
+
+# --- CircuitBreaker ----------------------------------------------------------
+
+
+def _tripped_breaker(clock, threshold=3, reset_after_s=30.0):
+    breaker = CircuitBreaker(
+        name="dep", failure_threshold=threshold, reset_after_s=reset_after_s, clock=clock
+    )
+    for _ in range(threshold):
+        breaker.record_failure()
+    return breaker
+
+
+def test_breaker_trips_after_threshold_consecutive_failures():
+    clock = FakeClock()
+    breaker = CircuitBreaker(name="dep", failure_threshold=3, clock=clock)
+    breaker.record_failure()
+    breaker.record_failure()
+    assert breaker.state == "closed" and breaker.allow()
+    # A success resets the consecutive count: failures must be consecutive.
+    breaker.record_success()
+    breaker.record_failure()
+    breaker.record_failure()
+    assert breaker.state == "closed"
+    breaker.record_failure()
+    assert breaker.state == "open"
+    assert not breaker.allow()
+    with pytest.raises(CircuitOpen) as err:
+        breaker.call(lambda: "never runs")
+    assert err.value.name == "dep" and err.value.failures == 3
+
+
+def test_breaker_half_open_admits_exactly_one_probe():
+    clock = FakeClock()
+    breaker = _tripped_breaker(clock, reset_after_s=30.0)
+    clock.advance(30.0)
+    assert breaker.state == "half-open"
+    assert breaker.allow()       # the probe slot
+    assert not breaker.allow()   # second caller refused while probe in flight
+
+
+def test_breaker_probe_success_closes_circuit():
+    clock = FakeClock()
+    breaker = _tripped_breaker(clock)
+    clock.advance(31.0)
+    assert breaker.call(lambda: "ok") == "ok"
+    assert breaker.state == "closed"
+    assert breaker.consecutive_failures == 0
+    assert breaker.allow()
+
+
+def test_breaker_probe_failure_restarts_cooldown():
+    clock = FakeClock()
+    breaker = _tripped_breaker(clock, reset_after_s=30.0)
+    clock.advance(31.0)
+    with pytest.raises(RuntimeError, match="probe failed"):
+        breaker.call(lambda: (_ for _ in ()).throw(RuntimeError("probe failed")))
+    assert breaker.state == "open"
+    clock.advance(29.0)  # cooldown restarted at the failed probe
+    assert breaker.state == "open" and not breaker.allow()
+    clock.advance(1.0)
+    assert breaker.state == "half-open"
+
+
+def test_breaker_publishes_degraded_events():
+    from deeplearning_cfn_tpu.obs.recorder import get_recorder
+
+    def count(kind):
+        return sum(1 for e in get_recorder().tail(4096) if e.get("kind") == kind)
+
+    clock = FakeClock()
+    degraded0 = count("degraded")
+    recovered0 = count("degraded_recovered")
+    breaker = _tripped_breaker(clock)
+    assert count("degraded") == degraded0 + 1
+    clock.advance(31.0)
+    breaker.call(lambda: "ok")
+    assert count("degraded_recovered") == recovered0 + 1
+
+
+# --- broker readiness poll (satellite: bounded with typed timeout) -----------
+
+
+def test_await_broker_ready_succeeds_without_wall_sleeps():
+    clock = RecordingClock()
+    calls = []
+
+    def probe():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionRefusedError("not yet")
+
+    await_broker_ready(probe, timeout_s=5.0, clock=clock)
+    assert len(calls) == 3
+    assert clock.sleeps  # backoff happened, on the fake clock
+
+
+def test_await_broker_ready_times_out_typed():
+    clock = FakeClock()
+
+    def never_up():
+        raise ConnectionRefusedError("nope")
+
+    with pytest.raises(BrokerTimeout) as err:
+        await_broker_ready(never_up, timeout_s=2.0, clock=clock)
+    assert isinstance(err.value, TimeoutError)
+    assert err.value.timeout_s == 2.0
+    assert clock.now() <= 2.0 + 1.0  # bounded: the poll did not run away
