@@ -591,6 +591,21 @@ def _status_spans(args) -> dict | None:
     return {name: agg.as_dict() for name, agg in sorted(stats.items())}
 
 
+def _status_pipeline(args) -> dict | None:
+    """Input-pipeline counter aggregates (per pipeline name) folded from
+    journaled ``input_pipeline`` events, or None (no journal / no
+    events).  The operator's answer to "is training input-bound?": a low
+    overlap_fraction with high consumer_wait_seconds means the device
+    outran the host producers (docs/PERFORMANCE.md)."""
+    if not args.journal:
+        return None
+    from deeplearning_cfn_tpu.obs.recorder import read_journal
+    from deeplearning_cfn_tpu.train.pipeline import fold_pipeline_events
+
+    folded = fold_pipeline_events(read_journal(args.journal, kind="input_pipeline"))
+    return dict(sorted(folded.items())) or None
+
+
 def _status_metrics(base: str) -> list | None:
     """Latest per-worker train/eval records from the JSONL metrics stream
     (JsonlMetricsSink files on the shared mount) — the operator view the
@@ -647,6 +662,7 @@ def cmd_status(args) -> int:
         )
     liveness = _status_liveness(args)
     spans = _status_spans(args)
+    pipeline = _status_pipeline(args)
     workers = _status_metrics(args.metrics_dir) if args.metrics_dir else None
     if args.metrics_dir and workers is None:
         print(f"no metrics under {args.metrics_dir}", file=sys.stderr)
@@ -656,12 +672,12 @@ def cmd_status(args) -> int:
 
         print(
             render_prometheus(
-                liveness, spans, cluster=args.cluster or ""
+                liveness, spans, cluster=args.cluster or "", pipeline=pipeline
             ),
             end="",
         )
         return 0
-    if liveness is None and spans is None:
+    if liveness is None and spans is None and pipeline is None:
         # Metrics-only: the original (round-4) output shape, unchanged.
         print(json.dumps(workers, indent=2))
         return 0
@@ -670,6 +686,8 @@ def cmd_status(args) -> int:
         out["liveness"] = liveness
     if spans is not None:
         out["spans"] = spans
+    if pipeline is not None:
+        out["input_pipeline"] = pipeline
     if workers is not None:
         out["workers"] = workers
     print(json.dumps(out, indent=2))

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from deeplearning_cfn_tpu.examples.common import (
     base_parser,
     default_mesh,
+    device_image_pipeline,
     image_pipeline,
     maybe_init_distributed,
     metrics_sink,
@@ -76,7 +77,9 @@ def main(argv: list[str] | None = None) -> dict:
     )
 
     ckpt, start_step = open_checkpointer(args)
-    batches, input_stats = image_pipeline(
+    # Device-resident pipeline: uint8 records stream raw, normalize and
+    # --augment_flip/--augment_crop run inside the jitted train step.
+    batches, input_stats, augment = device_image_pipeline(
         args, (32, 32, 3), ds, start_step=start_step
     )
 
@@ -102,6 +105,8 @@ def main(argv: list[str] | None = None) -> dict:
             log_every=args.log_every,
             # uint8 records normalize inside the jitted step (fast path).
             input_stats=input_stats,
+            # Flip/crop as a seeded on-device stage (train steps only).
+            augment=augment,
         ),
     )
     sample = next(iter(batches(1)))
@@ -128,6 +133,7 @@ def main(argv: list[str] | None = None) -> dict:
     state, losses = trainer.fit(
         state, batches(args.steps), steps=args.steps, logger=logger,
         stop_fn=stop_fn, checkpointer=ckpt,
+        prefetch_workers=args.prefetch_workers,
     )
     if ckpt:
         ckpt.save(int(jax.device_get(state.step)), state)

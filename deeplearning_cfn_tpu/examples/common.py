@@ -146,6 +146,12 @@ def base_parser(description: str) -> argparse.ArgumentParser:
              "fits effective batches the chip's HBM cannot hold at once",
     )
     p.add_argument(
+        "--prefetch_workers", type=int, default=1,
+        help="parallel host producer threads behind the device prefetcher "
+             "(reorder buffer keeps iteration order); raise for decode-"
+             "bound record pipelines",
+    )
+    p.add_argument(
         "--metrics_dir",
         default=os.environ.get("DLCFN_METRICS_DIR"),
         help="dir for structured per-worker JSONL metrics (typically the "
@@ -330,42 +336,20 @@ def token_record_loader(
     return loader, spec, data_vocab
 
 
-def image_pipeline(
-    args, image_shape, fallback_ds, eval_mode: bool = False, start_step: int = 0
+def _open_image_records(
+    args, image_shape, batch: int, eval_mode: bool = False, start_step: int = 0
 ):
-    """(batches_fn, input_stats) for an image trainer: DLC1 records
-    through the native loader when ``--data_dir`` is set (first existing
-    candidate dir wins, the run.sh:21-35 data-source probe), else the
-    synthetic dataset.
-
-    uint8 records (real-dataset converters) are yielded RAW: the second
-    return value is the per-channel (mean, std) for
-    ``TrainerConfig.input_stats``, so normalization runs inside the jitted
-    step.  Host-side float normalization caps the pipeline at ~400
-    imagenet-rec/s/core while the uint8 path sustains thousands, and uint8
-    halves host->device bytes (docs/BENCH_NOTES.md).  Float records and
-    synthetic data return ``None`` stats.
-
-    Every process feeds the trainer the full global batch (the fit()
-    contract), so in multi-process runs the record stream must be
-    IDENTICAL on every host: guaranteed by the shared default seed plus
-    the loader's ticket-ordered delivery (the C++ reorder window makes
-    the stream invariant to decode thread count and scheduling).
-    Per-host shard loading belongs to the
-    `make_array_from_process_local_data` path
-    (examples/multiprocess_smoke.py), not here.
-
-    ``eval_mode`` gives an unshuffled single pass over the test/val split
-    (when staged) for held-out scoring.
-    """
-    if not args.data_dir:
-        return fallback_ds.batches, None
+    """Open --data_dir image records (the shared half of
+    :func:`image_pipeline` and :func:`device_image_pipeline`):
+    ``(loader, input_stats, margin_spec)``.  ``input_stats`` is the
+    per-channel (mean, std) tuple for uint8 records (None for float32
+    records); ``margin_spec`` is non-None when records are stored LARGER
+    than the model input and must be cropped down."""
     from deeplearning_cfn_tpu.train.datasets import STATS, read_stats_sidecar
     from deeplearning_cfn_tpu.train.native_loader import NativeRecordLoader
     from deeplearning_cfn_tpu.train.records import RecordSpec, read_header
 
     root, paths = record_paths(args.data_dir, eval_mode)
-    batch = args.global_batch_size or fallback_ds.batch_size
     # Records may be float32 (synthetic staging), uint8 at the model's
     # input size (real-dataset converters, train/datasets.py), or uint8
     # LARGER than it (margin-converted for random-crop augmentation);
@@ -417,7 +401,7 @@ def image_pipeline(
         if margin_spec is not None else "",
     )
     if not is_u8:
-        return loader.batches, None
+        return loader, None, None
 
     # The converter pins the normalization identity in stats.json; the
     # shape-based guess is only a fallback for hand-rolled record dirs.
@@ -440,6 +424,48 @@ def image_pipeline(
         )
         stats = STATS[guess]
     input_stats = (tuple(stats.mean.tolist()), tuple(stats.std.tolist()))
+    return loader, input_stats, margin_spec
+
+
+def image_pipeline(
+    args, image_shape, fallback_ds, eval_mode: bool = False, start_step: int = 0
+):
+    """(batches_fn, input_stats) for an image trainer: DLC1 records
+    through the native loader when ``--data_dir`` is set (first existing
+    candidate dir wins, the run.sh:21-35 data-source probe), else the
+    synthetic dataset.
+
+    uint8 records (real-dataset converters) are yielded RAW: the second
+    return value is the per-channel (mean, std) for
+    ``TrainerConfig.input_stats``, so normalization runs inside the jitted
+    step.  Host-side float normalization caps the pipeline at ~400
+    imagenet-rec/s/core while the uint8 path sustains thousands, and uint8
+    halves host->device bytes (docs/BENCH_NOTES.md).  Float records and
+    synthetic data return ``None`` stats.
+
+    Flip/crop augmentation here runs in HOST numpy per batch; prefer
+    :func:`device_image_pipeline`, which moves both into the jitted step.
+
+    Every process feeds the trainer the full global batch (the fit()
+    contract), so in multi-process runs the record stream must be
+    IDENTICAL on every host: guaranteed by the shared default seed plus
+    the loader's ticket-ordered delivery (the C++ reorder window makes
+    the stream invariant to decode thread count and scheduling).
+    Per-host shard loading belongs to the
+    `make_array_from_process_local_data` path
+    (examples/multiprocess_smoke.py), not here.
+
+    ``eval_mode`` gives an unshuffled single pass over the test/val split
+    (when staged) for held-out scoring.
+    """
+    if not args.data_dir:
+        return fallback_ds.batches, None
+    batch = args.global_batch_size or fallback_ds.batch_size
+    loader, input_stats, margin_spec = _open_image_records(
+        args, image_shape, batch, eval_mode, start_step
+    )
+    if input_stats is None:
+        return loader.batches, None
     flip = bool(getattr(args, "augment_flip", False)) and not eval_mode
     aug_crop = bool(getattr(args, "augment_crop", False)) and not eval_mode
     crop_pad = int(getattr(args, "crop_pad", 4) or 0)
@@ -476,6 +502,63 @@ def image_pipeline(
         return stream
 
     return batches, input_stats
+
+
+def device_image_pipeline(
+    args, image_shape, fallback_ds, eval_mode: bool = False, start_step: int = 0
+):
+    """(batches_fn, input_stats, augment) — the device-resident variant
+    of :func:`image_pipeline`: records stream RAW (uint8 stays uint8 over
+    PCIe, a 4x byte cut vs float32), normalization runs inside the jitted
+    step (``TrainerConfig.input_stats``), and --augment_flip /
+    --augment_crop become a :class:`train.augment.DeviceAugment` for
+    ``TrainerConfig.augment`` instead of per-batch host numpy — host
+    producers only decode and batch (docs/PERFORMANCE.md).
+
+    Margin-converted records (stored larger than the model input) crop ON
+    DEVICE: the trainer's step receives stored-size images and the
+    augment stage slices them down, so init/compile must use a stored-size
+    sample (conv params are H/W-independent, so the trained model is
+    identical).  Eval streams are never augmented: margin records are
+    center-cropped host-side (a cheap slice) and ``augment`` is None.
+    """
+    from deeplearning_cfn_tpu.train.augment import DeviceAugment
+
+    target_hw = (int(image_shape[0]), int(image_shape[1]))
+    flip = bool(getattr(args, "augment_flip", False)) and not eval_mode
+    aug_crop = bool(getattr(args, "augment_crop", False)) and not eval_mode
+    crop_pad = int(getattr(args, "crop_pad", 4) or 0)
+
+    def build_augment(margin: bool):
+        crop, pad, random_crop = None, 0, True
+        if margin:
+            # Stored-size inputs MUST come down to the model size every
+            # step; augmentation only decides random vs center window.
+            crop, random_crop = target_hw, aug_crop
+        elif aug_crop:
+            # Same-size records: the classic pad-and-crop recipe.
+            crop, pad = target_hw, crop_pad
+        aug = DeviceAugment(flip=flip, crop=crop, pad=pad, random_crop=random_crop)
+        return None if aug.is_identity else aug
+
+    if not args.data_dir:
+        stats = getattr(fallback_ds, "input_stats", None)
+        augment = None if eval_mode else build_augment(False)
+        return fallback_ds.batches, stats, augment
+    batch = args.global_batch_size or fallback_ds.batch_size
+    loader, input_stats, margin_spec = _open_image_records(
+        args, image_shape, batch, eval_mode, start_step
+    )
+    if eval_mode:
+        if margin_spec is not None:
+            from deeplearning_cfn_tpu.train.datasets import center_crop_batches
+
+            def batches(steps):
+                return center_crop_batches(loader.batches(steps), target_hw)
+
+            return batches, input_stats, None
+        return loader.batches, input_stats, None
+    return loader.batches, input_stats, build_augment(margin_spec is not None)
 
 
 def image_batches(args, image_shape, fallback_ds, eval_mode: bool = False):

@@ -27,6 +27,7 @@ from deeplearning_cfn_tpu.examples.common import (
 )
 from deeplearning_cfn_tpu.models import retinanet
 from deeplearning_cfn_tpu.train.data import SyntheticDetectionDataset
+from deeplearning_cfn_tpu.train.datasets import IMAGENET_MEAN, IMAGENET_STD
 from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
 from deeplearning_cfn_tpu.utils.compat import set_mesh
 
@@ -119,7 +120,10 @@ def record_batches(args, batch: int, eval_mode: bool = False):
         loop=not eval_mode,
         n_threads=1 if (eval_mode or jax.process_count() > 1) else 4,
     )
-    return lambda steps: detection_batches(loader, spec, steps)
+    # normalize=False: images cross PCIe as stored uint8 (4x fewer bytes);
+    # the trainer dequantizes + normalizes inside the jitted step via
+    # TrainerConfig.input_stats (train/pipeline.py).
+    return lambda steps: detection_batches(loader, spec, steps, normalize=False)
 
 
 def main(argv: list[str] | None = None) -> dict:
@@ -199,6 +203,11 @@ def main(argv: list[str] | None = None) -> dict:
             grad_clip_norm=10.0,
             grad_accum_steps=args.grad_accum,
             log_every=args.log_every,
+            # uint8 detection records dequantize + normalize in-step; the
+            # float synthetic stream passes through untouched.
+            input_stats=(
+                tuple(IMAGENET_MEAN.tolist()), tuple(IMAGENET_STD.tolist())
+            ),
         ),
         stateful_loss_fn=loss_fn,
     )
@@ -254,7 +263,8 @@ def main(argv: list[str] | None = None) -> dict:
         sample_y=jax.tree_util.tree_map(jnp.asarray, sample.y),
     )
     state, losses = trainer.fit(
-        state, batches(args.steps), steps=args.steps, logger=logger
+        state, batches(args.steps), steps=args.steps, logger=logger,
+        prefetch_workers=args.prefetch_workers,
     )
     result = {
         "final_loss": losses[-1],
@@ -291,6 +301,11 @@ def evaluate_map(model, trainer, state, anchors, args, batch, steps: int) -> dic
 
     @jax.jit
     def infer(params, model_state, x):
+        from deeplearning_cfn_tpu.train.pipeline import dequantize_normalize
+
+        # Raw uint8 eval records dequantize on device, exactly like the
+        # train step; float batches pass through untouched.
+        x = dequantize_normalize(x, IMAGENET_MEAN, IMAGENET_STD)
         variables = {"params": params, **model_state}
         outputs = model.apply(variables, x, train=False)
         if with_masks:

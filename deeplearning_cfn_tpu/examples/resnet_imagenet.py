@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from deeplearning_cfn_tpu.examples.common import (
     base_parser,
     default_mesh,
+    device_image_pipeline,
     image_pipeline,
     maybe_init_distributed,
     metrics_sink,
@@ -80,7 +81,10 @@ def main(argv: list[str] | None = None) -> dict:
     )
 
     ckpt, start_step = open_checkpointer(args)
-    batches, input_stats = image_pipeline(
+    # Device-resident pipeline: uint8 records stream raw (compact PCIe
+    # payload), normalize + flip/crop run inside the jitted step
+    # (train/pipeline.py, train/augment.py).
+    batches, input_stats, augment = device_image_pipeline(
         args, (args.image_size, args.image_size, 3), ds,
         start_step=start_step,
     )
@@ -106,6 +110,8 @@ def main(argv: list[str] | None = None) -> dict:
             log_every=args.log_every,
             # uint8 records normalize inside the jitted step (fast path).
             input_stats=input_stats,
+            # Flip/crop as a seeded on-device stage (train steps only).
+            augment=augment,
         ),
     )
     sample = next(iter(batches(1)))
@@ -165,7 +171,7 @@ def main(argv: list[str] | None = None) -> dict:
             chunk = min(eval_every, args.steps - done)
             state, chunk_losses = trainer.fit(
                 state, train_iter, steps=chunk, logger=logger,
-                checkpointer=ckpt,
+                checkpointer=ckpt, prefetch_workers=args.prefetch_workers,
             )
             losses.extend(chunk_losses)
             done += chunk
@@ -197,7 +203,7 @@ def main(argv: list[str] | None = None) -> dict:
     else:
         state, losses = trainer.fit(
             state, batches(args.steps), steps=args.steps, logger=logger,
-            checkpointer=ckpt,
+            checkpointer=ckpt, prefetch_workers=args.prefetch_workers,
         )
         if args.eval_steps:
             eval_batches, split = eval_source()

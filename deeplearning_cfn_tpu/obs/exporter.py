@@ -26,11 +26,14 @@ def render_prometheus(
     liveness: Mapping[str, Mapping[str, Any]] | None = None,
     spans: Mapping[str, Mapping[str, Any]] | None = None,
     cluster: str = "",
+    pipeline: Mapping[str, Mapping[str, Any]] | None = None,
 ) -> str:
-    """Render liveness snapshot + span aggregates as Prometheus text.
+    """Render liveness snapshot + span aggregates + input-pipeline
+    counters as Prometheus text.
 
     ``liveness`` is ``LivenessTable.snapshot()``; ``spans`` is
-    ``tracing.span_aggregates()``.  Either may be None/empty.
+    ``tracing.span_aggregates()``; ``pipeline`` is
+    ``train.pipeline.fold_pipeline_events()``.  Any may be None/empty.
     """
     lines: list[str] = []
     if liveness:
@@ -78,4 +81,25 @@ def render_prometheus(
         ]
         for name, agg in spans.items():
             lines.append(f"dlcfn_span_seconds_max{_labels(span=name)} {agg['max_s']}")
+    if pipeline:
+        gauges = (
+            ("bytes_transferred", "Host->device bytes moved by the input pipeline."),
+            ("host_input_seconds", "Seconds producers spent in the source iterator."),
+            ("producer_stall_seconds", "Seconds producers blocked on a full buffer."),
+            ("consumer_wait_seconds", "Seconds the training loop waited for input."),
+            ("overlap_fraction", "Fraction of the run with input hidden behind compute."),
+        )
+        for key, help_text in gauges:
+            lines += [
+                f"# HELP dlcfn_input_pipeline_{key} {help_text}",
+                f"# TYPE dlcfn_input_pipeline_{key} gauge",
+            ]
+            for name, agg in pipeline.items():
+                value = agg.get(key)
+                if value is None:
+                    continue
+                lines.append(
+                    f"dlcfn_input_pipeline_{key}"
+                    f"{_labels(cluster=cluster, pipeline=name)} {value}"
+                )
     return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,89 @@
+"""On-device image augmentation: a jitted, seeded stage in front of the
+train step.
+
+The host pipeline's per-batch numpy flip/crop (datasets.flipped_batches /
+random_crop_batches) caps producer throughput and burns host cores the
+loader needs for decode.  :class:`DeviceAugment` moves both transforms
+into the compiled step: the trainer composes ``augment(state.step, x)``
+in front of the loss (trainer._raw_step_fn), so host producers only
+decode and batch, augmentation runs on-chip in the input dtype (uint8
+stays uint8 — the compact PCIe payload is preserved), and XLA fuses the
+gather/select into the input side of the program.
+
+Determinism contract: randomness is ``jax.random`` keyed by ``seed`` and
+folded with the TRAINING step (``jax.random.fold_in``), so a given
+(seed, step) always applies the same flips/windows — resume-stable
+(state.step is checkpointed), multi-host identical (every process traces
+the same fold), and independent of prefetch depth or worker count.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class DeviceAugment:
+    """Flip / crop applied inside the jitted step to [B, H, W, C] images.
+
+    - ``flip``: per-image horizontal coin flip (the
+      ``flipped_batches`` recipe, on device).
+    - ``crop=(th, tw)``: every output is ``th x tw``.  Inputs LARGER
+      than the target take a window (random when ``random_crop``, else
+      the deterministic center window — the margin-records path);
+      inputs EQUAL to the target with ``pad`` > 0 zero-pad then crop
+      (the classic CIFAR pad-4 recipe).
+    - ``seed``: the stream identity; the per-step key is
+      ``fold_in(key(seed), step)``.
+    """
+
+    flip: bool = False
+    crop: tuple[int, int] | None = None
+    pad: int = 0
+    random_crop: bool = True
+    seed: int = 0
+
+    def __call__(self, step, x):
+        """Traced inside jit: ``step`` is the (device) training step."""
+        import jax
+        import jax.numpy as jnp
+
+        key = jax.random.fold_in(jax.random.key(self.seed), step)
+        crop_key, flip_key = jax.random.split(key)
+        if self.crop is not None:
+            x = self._crop(crop_key, x)
+        if self.flip:
+            coin = jax.random.bernoulli(flip_key, 0.5, (x.shape[0],))
+            x = jnp.where(coin[:, None, None, None], x[:, :, ::-1, :], x)
+        return x
+
+    def _crop(self, key, x):
+        import jax
+        import jax.numpy as jnp
+
+        th, tw = self.crop
+        b, h, w, c = x.shape
+        if (h, w) == (th, tw):
+            if not self.pad:
+                return x
+            p = int(self.pad)
+            x = jnp.pad(x, ((0, 0), (p, p), (p, p), (0, 0)))
+            h, w = h + 2 * p, w + 2 * p
+        if h < th or w < tw:
+            raise ValueError(f"cannot crop {h}x{w} inputs to {th}x{tw}")
+        if self.random_crop:
+            ky, kx = jax.random.split(key)
+            ys = jax.random.randint(ky, (b,), 0, h - th + 1)
+            xs = jax.random.randint(kx, (b,), 0, w - tw + 1)
+        else:
+            ys = jnp.full((b,), (h - th) // 2, jnp.int32)
+            xs = jnp.full((b,), (w - tw) // 2, jnp.int32)
+        return jax.vmap(
+            lambda img, oy, ox: jax.lax.dynamic_slice(
+                img, (oy, ox, 0), (th, tw, c)
+            )
+        )(x, ys, xs)
+
+    @property
+    def is_identity(self) -> bool:
+        return not self.flip and self.crop is None

@@ -15,6 +15,7 @@ the same ``Dataset`` protocol so trainers don't care which backs them.
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Iterator
@@ -53,6 +54,10 @@ class SyntheticDataset:
     num_classes: int = 10
     batch_size: int = 32
     seed: int = 0
+    # "uint8" is the compact-transfer dtype: samples are affinely mapped
+    # into [0, 255] and quantized, so the host->device payload is 4x
+    # smaller than float32 and the dequantize+normalize runs inside the
+    # jitted step (``TrainerConfig.input_stats`` = ``self.input_stats``).
     dtype: str = "float32"
 
     noise_scale: float = 1.0
@@ -61,27 +66,85 @@ class SyntheticDataset:
     # but uses a different seed — same task, disjoint samples.  None =
     # templates follow ``seed`` (original behavior).
     template_seed: int | None = None
+    # Pregenerate a seeded pool of this many batches and cycle through
+    # them: the per-step host cost drops to an index, so imagenet-like
+    # synthetic benches measure the pipeline, not standard_normal.  None
+    # keeps fresh per-step sampling (the convergence-test path — cycling
+    # repeats samples, fine for throughput, wrong for loss curves).
+    pool_batches: int | None = None
 
-    def batches(self, steps: int) -> Iterator[Batch]:
-        rng = np.random.default_rng(self.seed)
-        # Each class has a fixed random template; samples are template +
-        # noise.  Learnable in a few dozen steps, so "loss decreases" is a
-        # meaningful assertion, while noise keeps it from being trivial.
+    # Samples land roughly in templates±(3-4)sigma; the affine map
+    # (x * SCALE + OFFSET) * 255 puts that range inside [0, 255] with
+    # slight clipping at the tails.  input_stats inverts it exactly.
+    _U8_OFFSET = 0.5
+    _U8_SCALE = 0.125
+
+    @property
+    def input_stats(self) -> tuple[tuple[float, ...], tuple[float, ...]] | None:
+        """Per-channel (mean, std) in the /255 domain that make the
+        in-step ``dequantize_normalize`` invert the uint8 quantization —
+        pass straight to ``TrainerConfig.input_stats``.  None for float
+        dtypes (no normalization needed)."""
+        if self.dtype != "uint8":
+            return None
+        c = int(self.shape[-1])
+        return ((self._U8_OFFSET,) * c, (self._U8_SCALE,) * c)
+
+    def _quantize(self, x: np.ndarray) -> np.ndarray:
+        scaled = (x * self._U8_SCALE + self._U8_OFFSET) * 255.0
+        return np.clip(np.rint(scaled), 0, 255).astype(np.uint8)
+
+    def _finalize(self, x: np.ndarray) -> np.ndarray:
+        return self._quantize(x) if self.dtype == "uint8" else x.astype(self.dtype)
+
+    def _templates(self, rng: np.random.Generator) -> np.ndarray:
         template_rng = (
             np.random.default_rng(self.template_seed)
             if self.template_seed is not None
             else rng
         )
-        templates = template_rng.standard_normal(
+        return template_rng.standard_normal(
             (self.num_classes, *self.shape)
         ).astype(np.float32)
+
+    def batches(self, steps: int) -> Iterator[Batch]:
+        if self.pool_batches:
+            yield from self._pooled_batches(steps)
+            return
+        rng = np.random.default_rng(self.seed)
+        # Each class has a fixed random template; samples are template +
+        # noise.  Learnable in a few dozen steps, so "loss decreases" is a
+        # meaningful assertion, while noise keeps it from being trivial.
+        templates = self._templates(rng)
         for _ in range(steps):
             y = rng.integers(0, self.num_classes, size=self.batch_size).astype(np.int32)
             noise = rng.standard_normal((self.batch_size, *self.shape)).astype(
                 np.float32
             )
-            x = (templates[y] + self.noise_scale * noise).astype(self.dtype)
+            x = self._finalize(templates[y] + self.noise_scale * noise)
             yield Batch(x=x, y=y)
+
+    def _pooled_batches(self, steps: int) -> Iterator[Batch]:
+        """Vectorized pool generation: ONE rng call for all K batches'
+        labels and one for the noise, then cycle — per-step host cost is
+        an index into preallocated arrays."""
+        rng = np.random.default_rng(self.seed)
+        templates = self._templates(rng)
+        # The pool is always the FULL pool_batches, never clamped to
+        # ``steps``: clamping would make the stream's contents depend on
+        # how many steps the caller asked for, breaking same-seed
+        # reproducibility between short and long runs.
+        k = max(1, int(self.pool_batches))
+        y = rng.integers(
+            0, self.num_classes, size=(k, self.batch_size)
+        ).astype(np.int32)
+        noise = rng.standard_normal(
+            (k, self.batch_size, *self.shape), dtype=np.float32
+        )
+        x = self._finalize(templates[y] + self.noise_scale * noise)
+        for i in range(steps):
+            b = i % k
+            yield Batch(x=x[b], y=y[b])
 
     @classmethod
     def mnist_like(cls, batch_size: int, seed: int = 0) -> "SyntheticDataset":
@@ -89,7 +152,12 @@ class SyntheticDataset:
 
     @classmethod
     def imagenet_like(
-        cls, batch_size: int, image_size: int = 224, seed: int = 0, dtype: str = "float32"
+        cls,
+        batch_size: int,
+        image_size: int = 224,
+        seed: int = 0,
+        dtype: str = "float32",
+        pool_batches: int | None = None,
     ) -> "SyntheticDataset":
         return cls(
             shape=(image_size, image_size, 3),
@@ -97,6 +165,7 @@ class SyntheticDataset:
             batch_size=batch_size,
             seed=seed,
             dtype=dtype,
+            pool_batches=pool_batches,
         )
 
 
@@ -266,67 +335,172 @@ class SyntheticDetectionDataset:
 
 def device_put_batch(batch: Batch, sharding) -> tuple[jax.Array, jax.Array]:
     """Place a host batch onto the mesh with the batch sharding — the only
-    host->device transfer in the hot loop."""
+    host->device transfer in the hot loop.  Leaves already carrying an
+    equivalent sharding (prefetched batches) pass through untouched."""
     return (
-        jax.device_put(batch.x, sharding),
-        jax.device_put(batch.y, sharding),
+        device_put_tree(batch.x, sharding),
+        device_put_tree(batch.y, sharding),
+    )
+
+
+def _placed_with(leaf, sharding) -> bool:
+    """True when ``leaf`` is a committed jax.Array already laid out as
+    ``sharding`` — re-issuing device_put for it would at best be a no-op
+    and at worst a layout check on the hot path."""
+    if not isinstance(leaf, jax.Array):
+        return False
+    current = getattr(leaf, "sharding", None)
+    if current is None:
+        return False
+    if current == sharding:
+        return True
+    try:
+        return current.is_equivalent_to(sharding, leaf.ndim)
+    except (AttributeError, TypeError, ValueError):
+        return False
+
+
+def device_put_tree(tree, sharding):
+    """``jax.device_put`` each leaf of a batch pytree UNLESS it already
+    carries an equivalent sharding (the prefetcher placed it): the
+    trainer's per-step transfer becomes an identity check for prefetched
+    batches instead of relying on device_put's no-op path."""
+    return jax.tree_util.tree_map(
+        lambda leaf: leaf
+        if _placed_with(leaf, sharding)
+        else jax.device_put(leaf, sharding),
+        tree,
     )
 
 
 class DevicePrefetcher:
-    """Background host→device pipeline: a producer thread pulls batches
-    from the host iterator (loader decode, normalization) and issues the
-    ``device_put`` up to ``size`` batches ahead, so input transfer overlaps
-    the previous step's compute instead of sitting on the critical path.
-    The TPU equivalent of the double-buffered input pipelines the
-    reference's external frameworks provided (SURVEY §2.2).
+    """Background host→device pipeline: producer threads pull batches
+    from the host iterator (loader decode, batching) and issue the
+    ``device_put`` up to ``size`` batches ahead, so input transfer
+    overlaps the previous step's compute instead of sitting on the
+    critical path.  The TPU equivalent of the double-buffered input
+    pipelines the reference's external frameworks provided (SURVEY §2.2).
 
-    Iteration order is exactly the source order; ``close()`` (or exhausting
-    the iterator) stops the producer — abandoned early-exit consumers do
-    not leak a blocked thread.
+    ``workers`` > 1 runs a small pool: the source iterator is pulled
+    under a lock (host decode stays ordered and exceptions deterministic)
+    while the transfers themselves proceed in parallel, feeding a
+    sequence-numbered reorder buffer — iteration order is EXACTLY the
+    source order and a source exception re-raises at the position it
+    occurred, identical to the single-worker path.
+
+    ``stats`` (a :class:`~deeplearning_cfn_tpu.train.pipeline.PipelineStats`)
+    counts transfer bytes, host-input seconds, producer stalls and
+    consumer waits; ``close()`` journals it once via the obs plane.
+
+    ``close()`` (or exhausting the iterator) stops the producers —
+    abandoned early-exit consumers do not leak a blocked thread.
     """
 
     _DONE = object()
 
-    def __init__(self, batches: Iterator[Batch], sharding, size: int = 2):
-        import queue
+    def __init__(
+        self,
+        batches: Iterator[Batch],
+        sharding,
+        size: int = 2,
+        workers: int = 1,
+        stats=None,
+    ):
         import threading
 
-        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, size))
+        self._src = iter(batches)
+        self._sharding = sharding
+        self._size = max(1, size)
+        self._stats = stats
         self._stop = threading.Event()
-        self._thread = threading.Thread(
-            target=self._produce, args=(batches, sharding), daemon=True
-        )
-        self._thread.start()
+        # _src_lock serializes source pulls (sequence assignment); _cond
+        # guards the reorder buffer and the consumer cursor.
+        self._src_lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._buf: dict[int, object] = {}  # seq -> Batch | exception | _DONE
+        self._next_pull = 0  # next sequence number (under _src_lock)
+        self._next_out = 0  # next sequence the consumer emits (under _cond)
+        self._done = False  # source exhausted/raised (under _src_lock)
+        self._threads = [
+            threading.Thread(target=self._produce, daemon=True)
+            for _ in range(max(1, int(workers)))
+        ]
+        for t in self._threads:
+            t.start()
 
-    def _produce(self, batches, sharding) -> None:
-        import queue
+    def _pull(self):
+        """One serialized source pull -> (seq, item); item is a Batch, an
+        exception (re-raised consumer-side at this position), _DONE, or
+        None when another worker already hit the end."""
+        with self._src_lock:
+            if self._done or self._stop.is_set():
+                return None, None
+            seq = self._next_pull
+            t0 = time.perf_counter()
+            try:
+                item = next(self._src)
+            except StopIteration:
+                item = self._DONE
+            except BaseException as e:  # dlcfn: noqa[DLC004] not swallowed: re-raised in the consumer's __iter__
+                item = e
+            if self._stats is not None:
+                self._stats.add_host_input(time.perf_counter() - t0)
+            self._next_pull = seq + 1
+            if item is self._DONE or isinstance(item, BaseException):
+                self._done = True
+            return seq, item
 
-        def put(item) -> bool:
-            while not self._stop.is_set():
-                try:
-                    self._q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
+    def _produce(self) -> None:
+        while not self._stop.is_set():
+            seq, item = self._pull()
+            if seq is None:
+                return
+            terminal = item is self._DONE or isinstance(item, BaseException)
+            if not terminal:
+                if self._stats is not None:
+                    from deeplearning_cfn_tpu.train.pipeline import nbytes_of
 
-        try:
-            for b in batches:
+                    self._stats.add_transfer(nbytes_of((item.x, item.y)))
+                item = Batch(*device_put_batch(item, self._sharding))
+            t0 = time.perf_counter()
+            with self._cond:
+                # Bound the buffer to ``size`` batches ahead of the
+                # consumer (terminal markers always land — they are the
+                # stream's end, not payload).
+                while (
+                    not terminal
+                    and seq >= self._next_out + self._size
+                    and not self._stop.is_set()
+                ):
+                    self._cond.wait(0.1)
                 if self._stop.is_set():
                     return
-                if not put(Batch(*device_put_batch(b, sharding))):
-                    return
-            put(self._DONE)
-        except BaseException as e:  # dlcfn: noqa[DLC004] not swallowed: re-raised in the consumer's __iter__
-            put(e)
+                self._buf[seq] = item
+                self._cond.notify_all()
+            if self._stats is not None and not terminal:
+                self._stats.add_producer_stall(time.perf_counter() - t0)
+            if terminal:
+                return
 
     def __iter__(self) -> Iterator[Batch]:
         # try/finally so an abandoned generator (consumer breaks out of its
         # for-loop without close()) still stops the producer on GC.
         try:
             while True:
-                item = self._q.get()
+                t0 = time.perf_counter()
+                with self._cond:
+                    while (
+                        self._next_out not in self._buf
+                        and not self._stop.is_set()
+                    ):
+                        self._cond.wait(0.1)
+                    if self._next_out not in self._buf:
+                        return  # stopped
+                    item = self._buf.pop(self._next_out)
+                    self._next_out += 1
+                    self._cond.notify_all()
+                if self._stats is not None:
+                    self._stats.add_consumer_wait(time.perf_counter() - t0)
                 if item is self._DONE:
                     return
                 if isinstance(item, BaseException):
@@ -337,6 +511,10 @@ class DevicePrefetcher:
 
     def close(self) -> None:
         self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._stats is not None:
+            self._stats.journal()
 
     def __enter__(self) -> "DevicePrefetcher":
         return self

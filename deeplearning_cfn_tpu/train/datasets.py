@@ -591,12 +591,17 @@ def convert_coco(
 
 
 def detection_batches(
-    loader, spec: RecordSpec, steps: int | None = None
+    loader, spec: RecordSpec, steps: int | None = None, normalize: bool = True
 ) -> Iterator[Batch]:
     """Decode detection records from a NativeRecordLoader into the
     trainer's ``Batch(x, y={"boxes", "classes"[, "masks"]})`` shape,
     normalizing images with ImageNet statistics.  Instance-mask records
-    (:func:`instance_spec`) pass their bitmaps through."""
+    (:func:`instance_spec`) pass their bitmaps through.
+
+    ``normalize=False`` yields images in the stored dtype (uint8 for
+    image records) — the compact-transfer path, where dequantize +
+    normalize run inside the jitted step via
+    ``TrainerConfig.input_stats`` (train/pipeline.py)."""
     has_masks = any(f.name == "masks" for f in spec.fields)
     i = 0
     while steps is None or i < steps:
@@ -607,10 +612,10 @@ def detection_batches(
         y = {"boxes": arrays["boxes"], "classes": arrays["classes"]}
         if has_masks:
             y["masks"] = arrays["masks"]
-        yield Batch(
-            x=normalize_images(arrays["x"], IMAGENET_MEAN, IMAGENET_STD),
-            y=y,
-        )
+        x = arrays["x"]
+        if normalize:
+            x = normalize_images(x, IMAGENET_MEAN, IMAGENET_STD)
+        yield Batch(x=x, y=y)
         i += 1
 
 

@@ -1,0 +1,207 @@
+"""Device-resident input pipeline: counters and the dtype policy.
+
+The host→device boundary is the first wall once the step itself is tuned
+(MLPerf TPU scaling, arxiv 1909.09756; cloud-cluster overlap studies,
+arxiv 2010.10458).  This module holds the two pieces every consumer of
+that boundary shares:
+
+- :func:`dequantize_normalize` — THE uint8→float normalization identity.
+  Loaders keep images uint8 across PCIe (4x fewer bytes than float32);
+  the dequantize + per-channel normalize runs inside the jitted step
+  (``TrainerConfig.input_stats``) where XLA fuses it into the first conv.
+  One implementation, used by the trainer, the bench harness, and the
+  golden-numerics test, so the on-device path can never drift from the
+  host-side ``datasets.normalize_images``.
+- :class:`PipelineStats` — per-run counters for the prefetch pipeline
+  (bytes over PCIe, host time producing batches, producer stalls,
+  consumer waits), journaled through the obs plane as one
+  ``input_pipeline`` event so ``dlcfn status --journal`` and bench.py
+  report the same numbers.
+
+Counter semantics (all wall-clock, perf_counter):
+
+- ``bytes_transferred``: host bytes handed to ``jax.device_put`` — the
+  PCIe payload.  uint8 images make this 4x smaller than float32 at the
+  same batch shape; that ratio is what the check.sh perf-smoke asserts.
+- ``host_input_seconds``: time spent inside the source iterator
+  (decode, batching, host-side shaping) across all producer workers.
+- ``producer_stall_seconds``: time producers spent blocked because the
+  reorder buffer was full — the pipeline was AHEAD of the device (good).
+- ``consumer_wait_seconds``: time the training loop blocked waiting for
+  the next batch — the device was ahead of the pipeline (input-bound).
+- ``overlap_fraction``: 1 - consumer_wait/elapsed — the fraction of the
+  run during which input production was hidden behind compute.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+
+def dequantize_normalize(x, mean, std, compute_dtype=None):
+    """uint8 [B, H, W, C] -> float, ``(x/255 - mean)/std`` per channel —
+    the jit-side twin of ``datasets.normalize_images`` (host path).
+    Traced inside the step so XLA fuses it into the first conv; float
+    inputs pass through untouched (synthetic / pre-normalized streams).
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``) casts the normalized
+    result, so the one on-chip conversion lands directly in the model's
+    compute dtype."""
+    import jax.numpy as jnp
+
+    if x.dtype == jnp.uint8:
+        mean = jnp.asarray(mean, jnp.float32)
+        std = jnp.asarray(std, jnp.float32)
+        x = (x.astype(jnp.float32) / 255.0 - mean) / std
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+    return x
+
+
+def nbytes_of(tree: Any) -> int:
+    """Total payload bytes of a batch pytree (numpy or jax leaves)."""
+    total = 0
+    for leaf in _leaves(tree):
+        n = getattr(leaf, "nbytes", None)
+        if n is None:
+            n = int(np.asarray(leaf).nbytes)
+        total += int(n)
+    return total
+
+
+def _leaves(tree: Any):
+    if isinstance(tree, dict):
+        for v in tree.values():
+            yield from _leaves(v)
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            yield from _leaves(v)
+    else:
+        yield tree
+
+
+class PipelineStats:
+    """Thread-safe counters for one prefetch pipeline run.
+
+    Producers (possibly several) fold in host-input time, transfer bytes
+    and stall time; the consumer folds in wait time.  ``snapshot()``
+    computes the derived overlap fraction; ``journal()`` records ONE
+    ``input_pipeline`` event on the flight recorder (idempotent, so
+    ``DevicePrefetcher.close()`` can call it from both the consumer's
+    finally and an explicit close without double-journaling).
+    """
+
+    def __init__(self, name: str = "input"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self.batches = 0
+        self.bytes_transferred = 0
+        self.host_input_seconds = 0.0
+        self.producer_stall_seconds = 0.0
+        self.consumer_wait_seconds = 0.0
+        self._journaled = False
+
+    # --- producer side ---------------------------------------------------
+    def add_host_input(self, seconds: float) -> None:
+        with self._lock:
+            self.host_input_seconds += seconds
+
+    def add_transfer(self, nbytes: int) -> None:
+        with self._lock:
+            self.bytes_transferred += int(nbytes)
+            self.batches += 1
+
+    def add_producer_stall(self, seconds: float) -> None:
+        with self._lock:
+            self.producer_stall_seconds += seconds
+
+    # --- consumer side ---------------------------------------------------
+    def add_consumer_wait(self, seconds: float) -> None:
+        with self._lock:
+            self.consumer_wait_seconds += seconds
+
+    # --- reporting --------------------------------------------------------
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            elapsed = max(time.perf_counter() - self._t0, 1e-9)
+            overlap = 1.0 - min(self.consumer_wait_seconds / elapsed, 1.0)
+            return {
+                "name": self.name,
+                "batches": self.batches,
+                "bytes_transferred": self.bytes_transferred,
+                "host_input_seconds": round(self.host_input_seconds, 6),
+                "producer_stall_seconds": round(self.producer_stall_seconds, 6),
+                "consumer_wait_seconds": round(self.consumer_wait_seconds, 6),
+                "elapsed_seconds": round(elapsed, 6),
+                "overlap_fraction": round(overlap, 4),
+            }
+
+    def journal(self, recorder=None) -> dict[str, Any] | None:
+        """Record the counters as one ``input_pipeline`` obs event.
+
+        Idempotent; a no-op (returns None) when no batch ever flowed —
+        an abandoned prefetcher must not pollute the journal."""
+        with self._lock:
+            if self._journaled or self.batches == 0:
+                return None
+            self._journaled = True
+        snap = self.snapshot()
+        from deeplearning_cfn_tpu.obs.recorder import get_recorder
+
+        (recorder or get_recorder()).record("input_pipeline", **snap)
+        return snap
+
+
+def fold_pipeline_events(events) -> dict[str, dict[str, Any]]:
+    """Aggregate journaled ``input_pipeline`` events per pipeline name —
+    the ``dlcfn status --journal`` fold (sums for counters, a weighted
+    mean for the overlap fraction)."""
+    out: dict[str, dict[str, Any]] = {}
+    for event in events:
+        name = event.get("name")
+        if not isinstance(name, str):
+            continue
+        agg = out.setdefault(
+            name,
+            {
+                "runs": 0,
+                "batches": 0,
+                "bytes_transferred": 0,
+                "host_input_seconds": 0.0,
+                "producer_stall_seconds": 0.0,
+                "consumer_wait_seconds": 0.0,
+                "elapsed_seconds": 0.0,
+            },
+        )
+        agg["runs"] += 1
+        for key in (
+            "batches",
+            "bytes_transferred",
+            "host_input_seconds",
+            "producer_stall_seconds",
+            "consumer_wait_seconds",
+            "elapsed_seconds",
+        ):
+            value = event.get(key)
+            if isinstance(value, (int, float)):
+                agg[key] += value
+    for agg in out.values():
+        elapsed = agg["elapsed_seconds"]
+        agg["overlap_fraction"] = (
+            round(1.0 - min(agg["consumer_wait_seconds"] / elapsed, 1.0), 4)
+            if elapsed > 0
+            else None
+        )
+        for key in (
+            "host_input_seconds",
+            "producer_stall_seconds",
+            "consumer_wait_seconds",
+            "elapsed_seconds",
+        ):
+            agg[key] = round(agg[key], 6)
+    return out

@@ -44,7 +44,7 @@ from deeplearning_cfn_tpu.parallel.sharding import (
     infer_param_sharding,
     replicated,
 )
-from deeplearning_cfn_tpu.train.data import device_put_batch
+from deeplearning_cfn_tpu.train.data import device_put_batch, device_put_tree
 from deeplearning_cfn_tpu.train.metrics import (
     ThroughputLogger,
     peak_flops_per_chip,
@@ -90,7 +90,17 @@ class TrainerConfig:
     # loader, host-side float normalization caps the input pipeline at
     # ~400 imagenet-rec/s/core while the uint8 path sustains thousands
     # (docs/BENCH_NOTES.md) — and uint8 halves host->device bytes vs bf16.
+    # Applied in front of EVERY loss (the default objective AND custom
+    # loss_fn/stateful_loss_fn), so uint8 streams work for detection too.
     input_stats: tuple[tuple[float, ...], tuple[float, ...]] | None = None
+    # On-device augmentation (train/augment.py DeviceAugment, or any
+    # ``fn(step, x) -> x``): composed in front of the loss inside the
+    # jitted TRAIN step, seeded by fold_in(seed, state.step) — host
+    # producers only decode and batch; flip/crop run on-chip, BEFORE the
+    # in-step normalization (so uint8 stays uint8 across PCIe and the
+    # pad-then-crop zeros match the host recipe's pre-normalize padding).
+    # Eval never augments.
+    augment: Any | None = None
     grad_clip_norm: float | None = None
     label_smoothing: float = 0.0
     lr_schedule: optax.Schedule | None = None
@@ -307,13 +317,15 @@ class Trainer:
     def _normalize_input(self, x: jax.Array) -> jax.Array:
         """In-step uint8 normalization (config.input_stats); float inputs
         pass through untouched so synthetic/pre-normalized paths are
-        unchanged."""
+        unchanged.  Delegates to the ONE shared implementation
+        (train/pipeline.dequantize_normalize) so the on-device path can
+        never drift from the host-side datasets.normalize_images."""
         stats = self.config.input_stats
         if stats is None or x.dtype != jnp.uint8:
             return x
-        mean = jnp.asarray(stats[0], jnp.float32)
-        std = jnp.asarray(stats[1], jnp.float32)
-        return (x.astype(jnp.float32) / 255.0 - mean) / std
+        from deeplearning_cfn_tpu.train.pipeline import dequantize_normalize
+
+        return dequantize_normalize(x, stats[0], stats[1])
 
     def _default_objective(
         self, params: Any, model_state: Any, x: jax.Array, y: jax.Array, train: bool
@@ -356,9 +368,14 @@ class Trainer:
     def init(self, rng: jax.Array, sample_x: jax.Array) -> TrainState:
         """Initialize params/opt-state and place them on the mesh."""
         init_kwargs = {"train": False} if self.config.has_train_arg else {}
-        # uint8 batches (input_stats) normalize in-step; the model itself
-        # always sees float inputs, including at init.
-        sample = self._normalize_input(jnp.asarray(sample_x[:1]))
+        # The model sees what the train step feeds it: the augment stage
+        # runs first (margin records crop stored-size inputs down to the
+        # model size — models with flatten heads need the cropped shape
+        # at init), then uint8 batches (input_stats) normalize in-step.
+        sample = jnp.asarray(sample_x[:1])
+        if self.config.augment is not None:
+            sample = self.config.augment(jnp.zeros((), jnp.int32), sample)
+        sample = self._normalize_input(sample)
         variables = jax.eval_shape(
             partial(self.model.init, rng, **init_kwargs), sample
         )
@@ -437,6 +454,8 @@ class Trainer:
         if accum < 1:
             raise ValueError(f"grad_accum_steps must be >= 1, got {accum}")
 
+        augment = self.config.augment
+
         def step_fn(state: TrainState, x: jax.Array, y: jax.Array):
             ctx = (
                 jax.default_matmul_precision(precision)
@@ -444,6 +463,16 @@ class Trainer:
                 else contextlib.nullcontext()
             )
             with ctx:
+                # The device-resident input stage, fused into the step:
+                # seeded augmentation (keyed by the training step, so the
+                # transform is resume-stable and prefetch-depth-invariant)
+                # then uint8 dequantize+normalize — custom losses receive
+                # float inputs exactly like the default objective
+                # (_normalize_input is a no-op for float x, so the
+                # default objective's own call cannot double-normalize).
+                if augment is not None:
+                    x = augment(state.step, x)
+                x = self._normalize_input(x)
                 if accum == 1:
                     (loss, (aux, new_model_state)), grads = jax.value_and_grad(
                         loss_fn, has_aux=True
@@ -557,6 +586,9 @@ class Trainer:
                 else contextlib.nullcontext()
             )
             with ctx:
+                # uint8 eval streams dequantize in-step like training,
+                # including for custom losses; augmentation is train-only.
+                x = self._normalize_input(x)
                 loss, aux = eval_loss(state.params, state.model_state, x, y)
             return {"loss": loss, **aux}
 
@@ -644,8 +676,13 @@ class Trainer:
         batches = trimmed(batches)
         prefetcher: DevicePrefetcher | None = None
         if prefetch > 0:
+            from deeplearning_cfn_tpu.train.pipeline import PipelineStats
+
             batches = prefetcher = DevicePrefetcher(
-                batches, self.batch_sharding, prefetch
+                batches,
+                self.batch_sharding,
+                prefetch,
+                stats=PipelineStats(name="eval"),
             )
         # Device scalars accumulate host-side and materialize in ONE
         # readback at the end — a per-batch float() would serialize the
@@ -654,6 +691,8 @@ class Trainer:
         try:
             with span("eval"):
                 for batch in batches:
+                    # device_put_batch skips leaves the prefetcher already
+                    # placed with an equivalent sharding.
                     x, y = device_put_batch(batch, self.batch_sharding)
                     with set_mesh(self.mesh):
                         metrics = eval_fn(state, x, y)
@@ -684,6 +723,7 @@ class Trainer:
         checkpointer: Any = None,
         stop_fn: Callable[[dict], bool] | None = None,
         prefetch: int = 2,
+        prefetch_workers: int = 1,
     ) -> tuple[TrainState, list[float]]:
         """``stop_fn(metrics) -> True`` ends training early — the
         time-to-accuracy mode (the reference's only published CIFAR metric
@@ -701,12 +741,20 @@ class Trainer:
         ``prefetch`` > 0 moves host-batch production and the
         host->device transfer onto a background thread, ``prefetch``
         batches ahead (train/data.py:DevicePrefetcher), so input IO
-        overlaps compute; 0 = inline transfers.  In every mode at most
-        ``steps`` batches are consumed from the caller's iterator (an
-        early ``stop_fn`` exit may have pulled up to ``prefetch`` of
-        those ahead without training on them).
+        overlaps compute; 0 = inline transfers.  ``prefetch_workers``
+        > 1 adds parallel producer threads behind a reorder buffer
+        (iteration order unchanged) for decode-bound sources.  In
+        every mode at most ``steps`` batches are consumed from the
+        caller's iterator (an early ``stop_fn`` exit may have pulled
+        up to ``prefetch`` of those ahead without training on them).
+
+        Pipeline counters for the run (bytes over PCIe, host input
+        time, stall/wait split) land on ``self.last_pipeline_stats``
+        and are journaled via the obs plane as an ``input_pipeline``
+        event (docs/PERFORMANCE.md).
         """
         from deeplearning_cfn_tpu.train.data import DevicePrefetcher
+        from deeplearning_cfn_tpu.train.pipeline import PipelineStats
 
         losses: list[float] = []
         pending: list[jax.Array] = []  # device scalars awaiting readback
@@ -717,9 +765,14 @@ class Trainer:
         # caller's iterator (a break-based guard would pull one extra).
         batches = itertools.islice(batches, steps)
         prefetcher: DevicePrefetcher | None = None
+        self.last_pipeline_stats = stats = PipelineStats(name="fit")
         if prefetch > 0:
             batches = prefetcher = DevicePrefetcher(
-                batches, self.batch_sharding, prefetch
+                batches,
+                self.batch_sharding,
+                prefetch,
+                workers=prefetch_workers,
+                stats=stats,
             )
         # Global step tracked host-side (syncing state.step every iteration
         # would stall the dispatch pipeline); resume-aware so checkpoints
@@ -729,14 +782,15 @@ class Trainer:
             for i, batch in enumerate(batches):
                 # Targets may be a pytree (e.g. detection {boxes, classes});
                 # every leaf leads with the batch axis, so one batch sharding
-                # applies uniformly — a single host->device transfer per batch
-                # (a no-op for already-placed prefetched batches).
+                # applies uniformly.  device_put_tree skips leaves the
+                # prefetcher already placed with an equivalent sharding —
+                # prefetched batches transfer zero bytes here.
                 # The span clocks HOST time: transfer + async dispatch, not
                 # device execution (docs/OBSERVABILITY.md) — a sudden jump
                 # here means the dispatch queue filled and the host blocked.
                 with span("train_step"):
-                    x = jax.device_put(batch.x, self.batch_sharding)
-                    y = jax.device_put(batch.y, self.batch_sharding)
+                    x = device_put_tree(batch.x, self.batch_sharding)
+                    y = device_put_tree(batch.y, self.batch_sharding)
                     with set_mesh(self.mesh):
                         state, metrics = step_fn(state, x, y)
                 gstep += 1
@@ -831,7 +885,14 @@ class Trainer:
         flops = None
         if peak is not None:
             if self.analytic_flops_fn is not None:
-                flops = self.analytic_flops_fn(sample_x) / self.mesh.size
+                fx = sample_x
+                if self.config.augment is not None:
+                    # Analytic flops follow the MODEL's input shape: the
+                    # augment stage may crop stored-size samples down.
+                    fx = self.config.augment(
+                        jnp.zeros((), jnp.int32), jnp.asarray(sample_x)
+                    )
+                flops = self.analytic_flops_fn(fx) / self.mesh.size
             elif state is not None and sample_y is not None:
                 flops = self.compile_stats(state, sample_x, sample_y)[
                     "flops_per_step"

@@ -17,6 +17,9 @@ JAX_PLATFORMS=cpu python -m deeplearning_cfn_tpu.cli chaos --all --seed 0 \
   > /tmp/_chaos.json || { cat /tmp/_chaos.json; exit 1; }
 echo "chaos: all scenarios held their invariants (report: /tmp/_chaos.json)"
 
+echo "== perf-smoke (compact-dtype input path, structural asserts only) =="
+JAX_PLATFORMS=cpu python scripts/perf_smoke.py || exit 1
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

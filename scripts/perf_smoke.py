@@ -1,0 +1,125 @@
+"""Perf-smoke gate: assert the compact-dtype input path is actually taken.
+
+Runs a tiny CPU pipeline microbench — the same uint8 synthetic stream a
+real bench uses, through ``DevicePrefetcher(workers=2)`` with counters —
+against a float32 baseline of identical shape, and asserts structural
+properties only (byte counts, batch counts, dtype preservation).  No
+wall-clock assertions: CI machines are noisy and this gate must never
+flake on a slow runner; docs/PERFORMANCE.md covers how to read the
+timing counters it prints.
+
+Exit 0 and one JSON line on success; exit 1 with a message on violation.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+BATCH = 8
+IMAGE = 32
+STEPS = 6
+WORKERS = 2
+
+
+def run_pipeline(dtype: str) -> tuple[dict, object]:
+    from deeplearning_cfn_tpu.train.data import DevicePrefetcher, SyntheticDataset
+    from deeplearning_cfn_tpu.train.pipeline import PipelineStats
+
+    ds = SyntheticDataset(
+        shape=(IMAGE, IMAGE, 3),
+        num_classes=10,
+        batch_size=BATCH,
+        dtype=dtype,
+        pool_batches=3,
+    )
+    sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    stats = PipelineStats(name=f"smoke-{dtype}")
+    prefetcher = DevicePrefetcher(
+        ds.batches(STEPS), sharding, size=2, workers=WORKERS, stats=stats
+    )
+    last_x = None
+    n = 0
+    try:
+        for batch in prefetcher:
+            last_x = batch.x
+            n += 1
+    finally:
+        prefetcher.close()
+    assert n == STEPS, f"{dtype}: consumed {n} batches, expected {STEPS}"
+    return stats.snapshot(), last_x
+
+
+def main() -> int:
+    u8_snap, u8_x = run_pipeline("uint8")
+    f32_snap, f32_x = run_pipeline("float32")
+
+    failures = []
+    if u8_x.dtype != jnp.uint8:
+        failures.append(f"uint8 pipeline delivered {u8_x.dtype} to the device")
+    if f32_x.dtype != jnp.float32:
+        failures.append(f"float32 baseline delivered {f32_x.dtype}")
+    if u8_snap["batches"] != STEPS or f32_snap["batches"] != STEPS:
+        failures.append(
+            f"batch counters diverged: u8={u8_snap['batches']} "
+            f"f32={f32_snap['batches']} expected={STEPS}"
+        )
+    # THE gate: the compact path must move strictly fewer bytes than the
+    # float32 baseline at identical shapes.  Labels (int32) are shared
+    # payload, so the ratio is < 1/4 + epsilon rather than exactly 1/4.
+    if not u8_snap["bytes_transferred"] < f32_snap["bytes_transferred"]:
+        failures.append(
+            f"compact-dtype path not taken: uint8 moved "
+            f"{u8_snap['bytes_transferred']} bytes vs float32 "
+            f"{f32_snap['bytes_transferred']}"
+        )
+    image_bytes_u8 = STEPS * BATCH * IMAGE * IMAGE * 3
+    label_bytes = STEPS * BATCH * 4
+    if u8_snap["bytes_transferred"] != image_bytes_u8 + label_bytes:
+        failures.append(
+            f"uint8 byte counter {u8_snap['bytes_transferred']} != expected "
+            f"{image_bytes_u8 + label_bytes} (images + int32 labels)"
+        )
+    # The in-step dequantize must invert the quantization: mean of the
+    # dequantized uint8 stream tracks the float stream's mean.
+    from deeplearning_cfn_tpu.train.pipeline import dequantize_normalize
+    from deeplearning_cfn_tpu.train.data import SyntheticDataset
+
+    ds = SyntheticDataset(
+        shape=(IMAGE, IMAGE, 3), num_classes=10, batch_size=BATCH, dtype="uint8"
+    )
+    mean, std = ds.input_stats
+    dq = np.asarray(dequantize_normalize(jnp.asarray(u8_x), mean, std))
+    if not np.isfinite(dq).all() or abs(float(dq.mean())) > 1.0:
+        failures.append(f"dequantized stream off-distribution (mean {dq.mean():.3f})")
+
+    if failures:
+        for f in failures:
+            print(f"perf-smoke: {f}", file=sys.stderr)
+        return 1
+    print(
+        json.dumps(
+            {
+                "uint8": u8_snap,
+                "float32": f32_snap,
+                "bytes_ratio": round(
+                    u8_snap["bytes_transferred"] / f32_snap["bytes_transferred"], 4
+                ),
+                "workers": WORKERS,
+            },
+            allow_nan=False,
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
