@@ -23,6 +23,12 @@ Catalog:
   breaker (fail-fast, half-open probe, re-trip).
 * ``slow-disk``   — torn and slow checkpoint writes against the atomic
   write protocol and the local -> objectstore fallback chain.
+* ``slice-loss-live`` — a whole slice dies mid-run under a REAL 2-slice
+  SPMD trainer (8 virtual CPU devices): the debounced terminate burst
+  must trigger exactly one live reshard onto the survivors with zero
+  restarts, no lost steps, preserved global batch (grad-accum rescale)
+  and loss continuity against an uninterrupted run; the forced-fallback
+  variant must degrade to the checkpoint/restore path and still line up.
 """
 
 from __future__ import annotations
@@ -542,11 +548,345 @@ def slow_disk(seed: int) -> ScenarioReport:
     return report
 
 
+# --- slice-loss-live ---------------------------------------------------------
+
+
+def _journal_count(kind: str) -> int:
+    from deeplearning_cfn_tpu.obs.recorder import get_recorder
+
+    return sum(1 for e in get_recorder().tail(4096) if e.get("kind") == kind)
+
+
+def slice_loss_live(seed: int) -> ScenarioReport:
+    """A slice dies mid-run; training must survive WITHOUT a restart.
+
+    Drives the real stack end-to-end on 8 virtual CPU devices: an SPMD
+    FSDP trainer on a 2-slice hybrid mesh, the elasticity controller's
+    terminate debouncer on a virtual clock, the LiveReshardManager's
+    surviving-topology derivation, and the device-to-device reshard in
+    ``Trainer.fit``'s pause seam.  Invariants: the 3-event terminate
+    burst (with a duplicate) coalesces into exactly ONE reshard; the
+    step count is monotone with no step lost or repeated; grad
+    accumulation rescales 1 -> 2 so the global batch is preserved on
+    half the devices; the loss curve matches an uninterrupted 8-device
+    run within tolerance.  A second pass forces the fallback: the
+    coordinator must journal ``reshard_fallback``, stop the episode
+    cleanly, and the checkpoint/restore path onto the surviving mesh
+    must line up with the same straight run.
+    """
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        # Must land before the backend first initializes; under pytest
+        # conftest already set it, and `dlcfn chaos` reaches here before
+        # any device query.
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import jax
+    import numpy as np
+    import flax.linen as nn
+
+    from deeplearning_cfn_tpu.analysis.schedules import VirtualClock, interleavings
+    from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+    from deeplearning_cfn_tpu.cluster.elasticity import (
+        ElasticityController,
+        GroupPolicy,
+    )
+    from deeplearning_cfn_tpu.cluster.recovery import LiveReshardManager
+    from deeplearning_cfn_tpu.parallel.mesh import (
+        MeshSpec,
+        hybrid_mesh_for_slices,
+        virtual_cpu_devices,
+    )
+    from deeplearning_cfn_tpu.provision.events import (
+        EventBus,
+        EventKind,
+        LifecycleEvent,
+    )
+    from deeplearning_cfn_tpu.train.checkpoint import Checkpointer
+    from deeplearning_cfn_tpu.train.data import SyntheticDataset
+    from deeplearning_cfn_tpu.train.reshard import (
+        LiveReshardCoordinator,
+        mesh_topology,
+        rescale_grad_accum,
+    )
+    from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
+
+    report = ScenarioReport("slice-loss-live", seed)
+    devices = virtual_cpu_devices(8)
+
+    class _MLP(nn.Module):
+        # fc2's 256x256 kernel (65536 elems) clears the FSDP heuristic's
+        # min_shard_elems, so the reshard moves genuinely sharded arrays.
+        @nn.compact
+        def __call__(self, x):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(256, name="fc1")(x))
+            x = nn.relu(nn.Dense(256, name="fc2")(x))
+            return nn.Dense(10, name="head")(x)
+
+    def make_contract() -> ClusterContract:
+        return ClusterContract.build(
+            cluster_name="chaos-live",
+            coordinator_ip="10.0.0.1",
+            other_worker_ips=["10.0.0.2", "10.0.0.3", "10.0.0.4"],
+            chips_per_worker=2,
+            storage_mount="/mnt/none",
+            slices={
+                "s0": ["10.0.0.1", "10.0.0.2"],
+                "s1": ["10.0.0.3", "10.0.0.4"],
+            },
+        )
+
+    def mesh_for(contract: ClusterContract):
+        n = contract.slices_count
+        per_slice = contract.total_chips // max(n, 1)
+        return hybrid_mesh_for_slices(
+            n,
+            ici_spec=MeshSpec.fsdp_parallel(per_slice),
+            dcn_axis="dp",
+            devices=devices[: contract.total_chips],
+        )
+
+    def make_config() -> TrainerConfig:
+        return TrainerConfig(
+            optimizer="adamw",
+            learning_rate=1e-3,
+            strategy="fsdp",
+            matmul_precision="float32",
+            log_every=1,
+            grad_accum_steps=1,
+        )
+
+    total_steps = 8
+    die_at = 3 + seed % 3  # the step boundary where the loss is visible
+    dataset = lambda: SyntheticDataset(  # noqa: E731 - fresh iterator per run
+        shape=(8, 8, 1), num_classes=10, batch_size=32, seed=seed
+    )
+    sample = next(iter(dataset().batches(1))).x
+
+    class _Backend:
+        """Event-plane-only backend: terminate handling never touches
+        describe/launch, so the bus is all the controller needs here."""
+
+        def __init__(self):
+            self.events = EventBus()
+
+    burst = ["10.0.0.3", "10.0.0.4", "10.0.0.3"]  # dup on purpose
+    order = list(interleavings(burst, count=1, seed=seed)[0])
+
+    def make_cluster(vclock):
+        backend = _Backend()
+        controller = ElasticityController(
+            backend=backend,
+            coordinator_queue_name="coord",
+            slice_loss_window_s=10.0,
+            clock=vclock,
+        )
+        controller.register(GroupPolicy("s0", 1, "sig-s0", coordinator=True))
+        controller.register(GroupPolicy("s1", 1, "sig-s1"))
+        controller.attach()
+        manager = LiveReshardManager(make_contract())
+        manager.attach(controller)
+        return backend, controller, manager
+
+    def eventful(src, backend, vclock):
+        """Publish the slice-s1 terminate burst while batch ``die_at`` is
+        being produced, then advance past the debounce window so the NEXT
+        step boundary sees one coalesced loss."""
+        for i, b in enumerate(src):
+            if i == die_at:
+                for ip in order:
+                    backend.events.publish(
+                        LifecycleEvent(
+                            kind=EventKind.INSTANCE_TERMINATE,
+                            group="s1",
+                            instance_id=ip,
+                            detail={"reason": "preempted"},
+                        )
+                    )
+                    vclock.advance(0.5)
+                vclock.advance(11.0)
+            yield b
+
+    def run_straight() -> list[float]:
+        trainer = Trainer(_MLP(), mesh_for(make_contract()), make_config())
+        state = trainer.init(jax.random.PRNGKey(seed), sample)
+        _, losses = trainer.fit(
+            state, dataset().batches(total_steps), steps=total_steps, prefetch=0
+        )
+        return losses
+
+    straight = run_straight()
+
+    # --- phase 1: live reshard ------------------------------------------
+    vclock = VirtualClock()
+    backend, controller, manager = make_cluster(vclock)
+    coordinator = LiveReshardCoordinator(
+        manager=manager,
+        mesh_for=mesh_for,
+        flush=controller.flush_slice_losses,
+        clock=vclock,
+    )
+    trainer = Trainer(_MLP(), mesh_for(manager.contract), make_config())
+    state = trainer.init(jax.random.PRNGKey(seed), sample)
+    coalesced_before = _journal_count("slice_loss_coalesced")
+    reshard_before = _journal_count("reshard")
+    rescale_before = _journal_count("grad_accum_rescaled")
+    state, live_losses = trainer.fit(
+        state,
+        eventful(dataset().batches(total_steps), backend, vclock),
+        steps=total_steps,
+        prefetch=0,
+        reshard=coordinator,
+    )
+    report.check(
+        len(live_losses) == total_steps
+        and int(jax.device_get(state.step)) == total_steps,
+        "no restart, no lost step: one fit() call trained every step "
+        "through the slice death (monotone step count)",
+    )
+    report.check(
+        coordinator.live_total == 1 and coordinator.fallback_total == 0,
+        "the 3-event terminate burst (incl. a duplicate) coalesced into "
+        "exactly one live reshard and zero fallbacks",
+    )
+    report.check(
+        _journal_count("slice_loss_coalesced") - coalesced_before == 1
+        and _journal_count("reshard") - reshard_before == 1,
+        "journal shows one coalesced slice loss and one reshard event",
+    )
+    report.check(
+        mesh_topology(trainer.mesh) == {"devices": 4, "axes": {"fsdp": 4}}
+        and manager.contract.slices_count == 1
+        and manager.contract.degraded,
+        "trainer rebound to the surviving 4-device fsdp mesh and the "
+        "contract degraded to the single surviving slice",
+    )
+    report.check(
+        trainer.config.grad_accum_steps
+        == rescale_grad_accum(1, 8, 4)
+        == 2
+        and _journal_count("grad_accum_rescaled") - rescale_before == 1,
+        "grad accumulation rescaled 1 -> 2 (journaled), preserving the "
+        "global batch of 32 on half the devices",
+    )
+    report.check(
+        np.allclose(live_losses[:die_at], straight[:die_at], rtol=1e-5, atol=1e-6),
+        "pre-loss losses identical to the uninterrupted run",
+    )
+    report.check(
+        bool(
+            np.allclose(live_losses, straight, rtol=5e-3, atol=1e-4)
+        ),
+        "loss continuity across the reshard: full curve matches the "
+        "uninterrupted 8-device run within tolerance",
+    )
+
+    # --- phase 2: forced fallback to the checkpoint path ----------------
+    root = Path(tempfile.mkdtemp(prefix="dlcfn-chaos-live-"))
+    fallback_losses: list[float] = []
+    restore_step = -1
+    try:
+        vclock2 = VirtualClock()
+        backend2, controller2, manager2 = make_cluster(vclock2)
+        forced = LiveReshardCoordinator(
+            manager=manager2,
+            mesh_for=mesh_for,
+            flush=controller2.flush_slice_losses,
+            clock=vclock2,
+            force_fallback=True,
+        )
+        ck = Checkpointer(
+            root / "ckpt", interval_s=None, every_steps=1, async_save=False
+        )
+        trainer1 = Trainer(_MLP(), mesh_for(manager2.contract), make_config())
+        state1 = trainer1.init(jax.random.PRNGKey(seed), sample)
+        fallback_before = _journal_count("reshard_fallback")
+        state1, losses1 = trainer1.fit(
+            state1,
+            eventful(dataset().batches(total_steps), backend2, vclock2),
+            steps=total_steps,
+            prefetch=0,
+            checkpointer=ck,
+            reshard=forced,
+        )
+        report.check(
+            forced.fallback_pending
+            and forced.fallback_total == 1
+            and _journal_count("reshard_fallback") - fallback_before == 1,
+            "forced fallback journaled reshard_fallback and stopped the "
+            "episode cleanly at the pause boundary",
+        )
+        report.check(
+            len(losses1) == die_at,
+            "fallback episode kept every loss up to the pause (graceful "
+            "stop, not an exception)",
+        )
+        # The existing restore path, on the topology the coordinator
+        # derived: a fresh trainer on the surviving mesh, orbax restoring
+        # the 8-device checkpoint onto 4-device shardings.
+        cfg2 = make_config()
+        cfg2.grad_accum_steps = rescale_grad_accum(
+            1, 8, mesh_for(forced.fallback_contract).size
+        )
+        trainer2 = Trainer(_MLP(), mesh_for(forced.fallback_contract), cfg2)
+        template = trainer2.init(jax.random.PRNGKey(seed), sample)
+        restored = ck.restore_latest(template)
+        assert restored is not None
+        state2, restore_step = restored
+        report.check(
+            restore_step == die_at,
+            "checkpoint tier held the pause step: no training step lost "
+            "across the fallback",
+        )
+        import itertools as _it
+
+        remaining = total_steps - restore_step
+        state2, losses2 = trainer2.fit(
+            state2,
+            _it.islice(dataset().batches(total_steps), restore_step, None),
+            steps=remaining,
+            prefetch=0,
+        )
+        fallback_losses = losses1 + losses2
+        report.check(
+            len(fallback_losses) == total_steps
+            and int(jax.device_get(state2.step)) == total_steps,
+            "fallback path completed the run: restore episode finished "
+            "the remaining steps with a monotone step count",
+        )
+        report.check(
+            bool(np.allclose(fallback_losses, straight, rtol=5e-3, atol=1e-4)),
+            "loss continuity across the fallback: combined curve matches "
+            "the uninterrupted run within tolerance",
+        )
+        ck.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    report.details.update(
+        die_at_step=die_at,
+        burst_order=order,
+        grad_accum_after=trainer.config.grad_accum_steps,
+        post_mesh=mesh_topology(trainer.mesh),
+        straight_losses=[round(v, 6) for v in straight],
+        live_losses=[round(v, 6) for v in live_losses],
+        fallback_losses=[round(v, 6) for v in fallback_losses],
+        fallback_restore_step=restore_step,
+    )
+    return report
+
+
 SCENARIOS: dict[str, Callable[[int], ScenarioReport]] = {
     "silent-death": silent_death,
     "partition": partition,
     "flaky-rpc": flaky_rpc,
     "slow-disk": slow_disk,
+    "slice-loss-live": slice_loss_live,
 }
 
 

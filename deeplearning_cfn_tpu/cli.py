@@ -606,6 +606,46 @@ def _status_pipeline(args) -> dict | None:
     return dict(sorted(folded.items())) or None
 
 
+def _status_reshard(args) -> dict | None:
+    """Live-reshard counters folded from journaled ``reshard`` /
+    ``reshard_fallback`` events, or None (no journal / no reshards).
+    Feeds the ``dlcfn_reshard_total`` / ``dlcfn_reshard_seconds`` gauges
+    in the Prometheus rendering."""
+    if not args.journal:
+        return None
+    from deeplearning_cfn_tpu.obs.exporter import fold_reshard_events
+    from deeplearning_cfn_tpu.obs.recorder import read_journal
+
+    return fold_reshard_events(read_journal(args.journal)) or None
+
+
+def _status_mesh(args) -> dict | None:
+    """The current mesh shape straight from the published cluster
+    contract (slices/workers/chips and the degraded flag) — after a live
+    reshard the surviving topology shows up here, so an operator can see
+    what the trainer is actually running on without touching the job."""
+    if not args.cluster:
+        return None
+    from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+
+    try:
+        contract = ClusterContract.read()
+    except (OSError, TypeError, ValueError, KeyError):
+        return None
+    if contract.cluster_name != args.cluster:
+        return None
+    return {
+        "cluster": contract.cluster_name,
+        "slices": contract.slices_count,
+        "workers": contract.workers_count,
+        "chips_total": contract.total_chips,
+        "degraded": contract.degraded,
+        "slice_groups": {
+            g: len(ips) for g, ips in (contract.slices or {}).items()
+        },
+    }
+
+
 def _status_metrics(base: str) -> list | None:
     """Latest per-worker train/eval records from the JSONL metrics stream
     (JsonlMetricsSink files on the shared mount) — the operator view the
@@ -663,6 +703,8 @@ def cmd_status(args) -> int:
     liveness = _status_liveness(args)
     spans = _status_spans(args)
     pipeline = _status_pipeline(args)
+    reshard = _status_reshard(args)
+    mesh = _status_mesh(args)
     workers = _status_metrics(args.metrics_dir) if args.metrics_dir else None
     if args.metrics_dir and workers is None:
         print(f"no metrics under {args.metrics_dir}", file=sys.stderr)
@@ -672,18 +714,27 @@ def cmd_status(args) -> int:
 
         print(
             render_prometheus(
-                liveness, spans, cluster=args.cluster or "", pipeline=pipeline
+                liveness,
+                spans,
+                cluster=args.cluster or "",
+                pipeline=pipeline,
+                reshard=reshard,
+                mesh=mesh,
             ),
             end="",
         )
         return 0
-    if liveness is None and spans is None and pipeline is None:
+    if liveness is None and spans is None and pipeline is None and mesh is None and reshard is None:
         # Metrics-only: the original (round-4) output shape, unchanged.
         print(json.dumps(workers, indent=2))
         return 0
     out: dict = {}
     if liveness is not None:
         out["liveness"] = liveness
+    if mesh is not None:
+        out["mesh"] = mesh
+    if reshard is not None:
+        out["reshard"] = reshard
     if spans is not None:
         out["spans"] = spans
     if pipeline is not None:
@@ -907,6 +958,14 @@ def cmd_chaos(args) -> int:
     Each scenario drives real components through seeded faults on virtual
     clocks and asserts recovery invariants; the report is deterministic
     per (scenario, seed).  Exit 1 if any invariant was violated."""
+    # slice-loss-live drives a real 8-device SPMD trainer; the flag only
+    # takes effect if it lands before the JAX backend first initializes,
+    # which is why it is set here rather than inside the scenario alone.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
     from deeplearning_cfn_tpu.chaos import SCENARIOS, run_scenario
 
     if args.list_scenarios:
@@ -1107,7 +1166,7 @@ def main(argv: list[str] | None = None) -> int:
     )
     px.add_argument("--scenario", default=None,
                     help="scenario name (see --list): silent-death, "
-                         "partition, flaky-rpc, slow-disk")
+                         "partition, flaky-rpc, slow-disk, slice-loss-live")
     px.add_argument("--seed", type=int, default=0,
                     help="fault-schedule seed; reports are deterministic "
                          "per (scenario, seed)")

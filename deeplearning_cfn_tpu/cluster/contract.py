@@ -154,6 +154,55 @@ class ClusterContract:
             slices=slices,
         )
 
+    def surviving(self, lost_groups) -> "ClusterContract":
+        """The post-loss contract: the same cluster minus the dead slices.
+
+        This is the topology half of live elastic resharding
+        (docs/RESILIENCE.md): when the liveness plane declares a slice
+        dead, the trainer re-forms its mesh from THIS derivation instead
+        of waiting for a reprovision.  Raises ``ValueError`` when a live
+        reshard is structurally impossible — no slice topology at all,
+        none of the named groups are slices here (idempotence against
+        duplicate/stale loss notifications is the caller's job), nothing
+        survives, or the coordinator's own slice died (process 0 is gone;
+        only the restart-provision path can help).  Goes through
+        :meth:`build` so the survivor ordering invariants (coordinator's
+        slice first, contiguous slices) are re-validated, and is marked
+        ``degraded`` — the same flag the launch-error path sets.
+        """
+        if not self.slices:
+            raise ValueError(
+                "contract has no slice topology; cannot derive survivors"
+            )
+        lost = {g for g in lost_groups if g in self.slices}
+        if not lost:
+            raise ValueError(
+                f"none of {sorted(set(lost_groups))} are slices of this "
+                f"contract (slices: {sorted(self.slices)})"
+            )
+        keep = {g: list(ips) for g, ips in self.slices.items() if g not in lost}
+        if not keep:
+            raise ValueError("no surviving slices; full reprovision required")
+        survivors = [ip for ips in keep.values() for ip in ips]
+        if self.coordinator_ip not in survivors:
+            raise ValueError(
+                f"coordinator {self.coordinator_ip}'s slice was lost; live "
+                "reshard impossible (process 0 is gone) — use the "
+                "recreate-and-restore path"
+            )
+        contract = ClusterContract.build(
+            cluster_name=self.cluster_name,
+            coordinator_ip=self.coordinator_ip,
+            other_worker_ips=[ip for ip in survivors if ip != self.coordinator_ip],
+            chips_per_worker=self.chips_per_worker,
+            storage_mount=self.storage_mount,
+            degraded=True,
+            slices=keep,
+        )
+        contract.coordinator_port = self.coordinator_port
+        contract.tags = dict(self.tags)
+        return contract
+
     # --- derived views ----------------------------------------------------
     @property
     def workers_count(self) -> int:

@@ -25,6 +25,7 @@ message.  Consumers dedup by group name, exactly as the master bootstrap did
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -47,6 +48,65 @@ class GroupPolicy:
 
 
 @dataclass
+class TerminateDebouncer:
+    """Coalesce per-group INSTANCE_TERMINATE bursts into one notification.
+
+    A multi-host slice death arrives as N terminate events (one per host,
+    possibly duplicated — the event bus is at-least-once).  Resharding once
+    per event would tear the mesh down N times; this debouncer opens a
+    window at the first loss in a group, buffers everything that lands
+    inside it (deduplicating by instance id), and hands the whole burst to
+    ``on_flush`` exactly once when the window elapses.  A loss arriving
+    after a flush opens a *new* window — two genuinely separate bursts are
+    two notifications, by design.
+
+    Time comes from the injected ``clock`` (``time.monotonic`` by default;
+    a virtual clock in tests and chaos scenarios), and flushing is pull —
+    callers decide the safe point, matching the detection/recovery split
+    documented in cluster/recovery.py.  Single-threaded by construction:
+    observe() runs inside synchronous event dispatch, flush() at the
+    caller's safe point.
+    """
+
+    window_s: float = 0.0
+    clock: Callable[[], float] = time.monotonic
+    on_flush: Callable[[str, list[LifecycleEvent]], None] | None = None
+    _pending: dict[str, list[LifecycleEvent]] = field(default_factory=dict)
+    _opened_at: dict[str, float] = field(default_factory=dict)
+    _seen: dict[str, set[str]] = field(default_factory=dict)
+
+    def observe(self, group: str, event: LifecycleEvent) -> None:
+        if group not in self._pending:
+            self._pending[group] = []
+            self._seen[group] = set()
+            self._opened_at[group] = self.clock()
+        if event.instance_id:
+            if event.instance_id in self._seen[group]:
+                return
+            self._seen[group].add(event.instance_id)
+        self._pending[group].append(event)
+
+    def flush(self, force: bool = False) -> list[tuple[str, list[LifecycleEvent]]]:
+        """Fire ``on_flush`` for every group whose window elapsed (or all
+        buffered groups when ``force``); returns the flushed bursts."""
+        now = self.clock()
+        ripe = [
+            g
+            for g, opened in self._opened_at.items()
+            if force or now - opened >= self.window_s
+        ]
+        out = []
+        for group in ripe:
+            burst = self._pending.pop(group)
+            self._opened_at.pop(group)
+            self._seen.pop(group)
+            out.append((group, burst))
+            if self.on_flush is not None:
+                self.on_flush(group, burst)
+        return out
+
+
+@dataclass
 class ElasticityController:
     backend: Backend
     coordinator_queue_name: str
@@ -58,6 +118,14 @@ class ElasticityController:
     # off this seam; the reference had no equivalent — its Lambda only
     # logged terminations (lambda_function.py:173-199).
     on_instance_loss: Callable[[GroupPolicy, LifecycleEvent], None] | None = None
+    # Called with (group, burst) once per coalesced terminate burst — the
+    # live-reshard seam (train/reshard.py).  Unlike on_instance_loss this
+    # fires from flush_slice_losses() at the caller's safe point, never
+    # inside event dispatch, so a reshard cannot re-enter the event bus.
+    on_slice_loss: Callable[[str, list[LifecycleEvent]], None] | None = None
+    slice_loss_window_s: float = 0.0
+    clock: Callable[[], float] = time.monotonic
+    _debounce: TerminateDebouncer | None = field(default=None, repr=False)
 
     def register(self, policy: GroupPolicy) -> None:
         self.policies[policy.name] = policy
@@ -173,3 +241,34 @@ class ElasticityController:
         )
         if self.on_instance_loss is not None:
             self.on_instance_loss(policy, event)
+        if self.on_slice_loss is not None:
+            if self._debounce is None:
+                self._debounce = TerminateDebouncer(
+                    window_s=self.slice_loss_window_s,
+                    clock=self.clock,
+                    on_flush=self._fire_slice_loss,
+                )
+            self._debounce.observe(policy.name, event)
+
+    def flush_slice_losses(self, force: bool = False) -> list[str]:
+        """Deliver coalesced slice-loss bursts whose debounce window has
+        elapsed (the live-reshard coordinator calls this at each step
+        boundary).  Returns the groups flushed."""
+        if self._debounce is None:
+            return []
+        return [group for group, _ in self._debounce.flush(force=force)]
+
+    def _fire_slice_loss(self, group: str, burst: list[LifecycleEvent]) -> None:
+        get_recorder().record(
+            "slice_loss_coalesced",
+            group=group,
+            instances=sorted(e.instance_id or "?" for e in burst),
+            events=len(burst),
+        )
+        log.warning(
+            "slice loss coalesced: group %s lost %d instance(s) in one burst",
+            group,
+            len(burst),
+        )
+        if self.on_slice_loss is not None:
+            self.on_slice_loss(group, burst)

@@ -28,7 +28,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable
 
-from deeplearning_cfn_tpu.cluster.elasticity import GroupPolicy
+from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+from deeplearning_cfn_tpu.cluster.elasticity import ElasticityController, GroupPolicy
 from deeplearning_cfn_tpu.obs.recorder import get_recorder
 from deeplearning_cfn_tpu.obs.tracing import span
 from deeplearning_cfn_tpu.provision.events import LifecycleEvent
@@ -78,6 +79,65 @@ class RecoveryManager:
         self.attach(result)
         get_recorder().record("recovery_done", lost=lost)
         return result
+
+
+@dataclass
+class LiveReshardManager:
+    """Arms on *coalesced slice losses*; derives the surviving topology.
+
+    The in-place analog of :class:`RecoveryManager`: where that one
+    recreates the cluster and restarts the training episode, this one
+    feeds the live-reshard coordinator (train/reshard.py), which re-forms
+    the mesh from ``surviving_contract()`` and migrates state
+    device-to-device with no restart at all.  Same detection/recovery
+    split as above — ``on_slice_loss`` fires from the controller's
+    debounce flush (itself pulled at a step boundary), and the trainer
+    consumes ``needs_reshard`` at that safe point.
+
+    ``commit(contract)`` advances the manager to the post-reshard
+    topology; a late duplicate flush for an already-removed group is then
+    ignored by the ``group in slices`` guard, keeping the whole path
+    idempotent under at-least-once event delivery.
+    """
+
+    contract: ClusterContract
+    lost_groups: set[str] = field(default_factory=set)
+    events: list[LifecycleEvent] = field(default_factory=list)
+
+    def attach(self, controller: ElasticityController) -> None:
+        controller.on_slice_loss = self.on_slice_loss
+
+    def on_slice_loss(self, group: str, burst: list[LifecycleEvent]) -> None:
+        slices = self.contract.slices or {}
+        if group not in slices:
+            log.info("slice-loss for unknown/already-removed group %s ignored", group)
+            return
+        self.lost_groups.add(group)
+        self.events.extend(burst)
+        get_recorder().record(
+            "slice_lost",
+            group=group,
+            instances=sorted(e.instance_id or "?" for e in burst),
+        )
+        log.warning(
+            "armed for live reshard: slice %s lost (%d slices pending)",
+            group,
+            len(self.lost_groups),
+        )
+
+    @property
+    def needs_reshard(self) -> bool:
+        return bool(self.lost_groups)
+
+    def surviving_contract(self) -> ClusterContract:
+        """Raises ValueError when live reshard is structurally impossible
+        (e.g. the coordinator's slice died) — see ClusterContract.surviving."""
+        return self.contract.surviving(self.lost_groups)
+
+    def commit(self, contract: ClusterContract) -> None:
+        self.contract = contract
+        self.lost_groups.clear()
+        self.events.clear()
 
 
 def run_with_recovery(

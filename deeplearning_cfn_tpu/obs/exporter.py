@@ -22,18 +22,56 @@ def _labels(**labels: str) -> str:
     return "{" + body + "}" if body else ""
 
 
+def fold_reshard_events(events) -> dict[str, Any]:
+    """Fold flight-journal ``reshard`` / ``reshard_fallback`` events into
+    the counters the exporter and ``dlcfn status`` surface.  Empty dict
+    when the journal holds neither kind."""
+    out: dict[str, Any] = {
+        "total": 0,
+        "seconds_total": 0.0,
+        "fallback_total": 0,
+        "last": None,
+    }
+    for event in events:
+        kind = event.get("kind")
+        if kind == "reshard":
+            out["total"] += 1
+            out["seconds_total"] = round(
+                out["seconds_total"] + float(event.get("seconds") or 0.0), 6
+            )
+            out["last"] = {
+                k: event.get(k)
+                for k in (
+                    "step",
+                    "old_topology",
+                    "new_topology",
+                    "grad_accum_before",
+                    "grad_accum_after",
+                )
+            }
+        elif kind == "reshard_fallback":
+            out["fallback_total"] += 1
+    if not out["total"] and not out["fallback_total"]:
+        return {}
+    return out
+
+
 def render_prometheus(
     liveness: Mapping[str, Mapping[str, Any]] | None = None,
     spans: Mapping[str, Mapping[str, Any]] | None = None,
     cluster: str = "",
     pipeline: Mapping[str, Mapping[str, Any]] | None = None,
+    reshard: Mapping[str, Any] | None = None,
+    mesh: Mapping[str, Any] | None = None,
 ) -> str:
     """Render liveness snapshot + span aggregates + input-pipeline
     counters as Prometheus text.
 
     ``liveness`` is ``LivenessTable.snapshot()``; ``spans`` is
     ``tracing.span_aggregates()``; ``pipeline`` is
-    ``train.pipeline.fold_pipeline_events()``.  Any may be None/empty.
+    ``train.pipeline.fold_pipeline_events()``; ``reshard`` is
+    ``fold_reshard_events()``; ``mesh`` is the current mesh/contract
+    shape from ``dlcfn status --cluster``.  Any may be None/empty.
     """
     lines: list[str] = []
     if liveness:
@@ -102,4 +140,38 @@ def render_prometheus(
                     f"dlcfn_input_pipeline_{key}"
                     f"{_labels(cluster=cluster, pipeline=name)} {value}"
                 )
+    if reshard:
+        counters = (
+            ("dlcfn_reshard_total", "counter", "Live elastic reshards completed.", "total"),
+            (
+                "dlcfn_reshard_seconds",
+                "gauge",
+                "Total seconds spent pausing and resharding (injected clock).",
+                "seconds_total",
+            ),
+            (
+                "dlcfn_reshard_fallback_total",
+                "counter",
+                "Reshards that degraded to the checkpoint/restore path.",
+                "fallback_total",
+            ),
+        )
+        for name, kind, help_text, key in counters:
+            lines += [f"# HELP {name} {help_text}", f"# TYPE {name} {kind}"]
+            lines.append(f"{name}{_labels(cluster=cluster)} {reshard.get(key, 0)}")
+    if mesh:
+        shape = (
+            ("slices", "Slices in the current cluster contract."),
+            ("workers", "Worker hosts in the current cluster contract."),
+            ("chips_total", "Total chips across the current mesh."),
+        )
+        for key, help_text in shape:
+            value = mesh.get(key)
+            if value is None:
+                continue
+            lines += [
+                f"# HELP dlcfn_mesh_{key} {help_text}",
+                f"# TYPE dlcfn_mesh_{key} gauge",
+            ]
+            lines.append(f"dlcfn_mesh_{key}{_labels(cluster=cluster)} {value}")
     return "\n".join(lines) + ("\n" if lines else "")

@@ -166,30 +166,71 @@ class CheckpointWriteError(OSError):
     """No checkpoint tier accepted the write."""
 
 
-def _envelope(step: int, state: dict) -> bytes:
+class TopologyMismatch(ValueError):
+    """A checkpoint written on one mesh topology was asked to restore onto
+    a different one.  Raised by ``restore_latest(expected_topology=...)``
+    so callers get a typed, actionable error at restore time instead of a
+    shape crash deep inside the first train step.  The live-reshard
+    fallback path (train/reshard.py) restores deliberately-cross-topology
+    via the orbax template path, which reshards; THIS checkpointer stores
+    raw trees and cannot."""
+
+    def __init__(self, expected: dict, found: dict, step: int):
+        self.expected = expected
+        self.found = found
+        self.step = step
+        super().__init__(
+            f"checkpoint step {step} was written on topology {found}, "
+            f"restore target is {expected}"
+        )
+
+
+# Envelope version 2 adds the optional ``mesh_topology`` field.  The sha256
+# covers the STATE body only, so v1 readers ignore the extra keys and v2
+# readers treat a v1 envelope (no topology) as unconstrained — both
+# directions stay compatible.
+ENVELOPE_VERSION = 2
+
+
+def _envelope(step: int, state: dict, mesh_topology: dict | None = None) -> bytes:
     from deeplearning_cfn_tpu.train.metrics import json_safe
 
     body = json.dumps(json_safe(state), sort_keys=True, allow_nan=False)
-    return json.dumps(
-        {
-            "step": step,
-            "sha256": hashlib.sha256(body.encode()).hexdigest(),
-            "state": json.loads(body),
-        },
-        allow_nan=False,
-    ).encode()
+    env = {
+        "step": step,
+        "sha256": hashlib.sha256(body.encode()).hexdigest(),
+        "state": json.loads(body),
+    }
+    if mesh_topology is not None:
+        env["version"] = ENVELOPE_VERSION
+        env["mesh_topology"] = json_safe(mesh_topology)
+    return json.dumps(env, allow_nan=False).encode()
 
 
-def _open_envelope(raw: bytes) -> tuple[dict, int] | None:
-    """Parse + verify an envelope; None for torn/corrupt bytes."""
+def _open_envelope(raw: bytes) -> tuple[dict, int, dict | None] | None:
+    """Parse + verify an envelope; None for torn/corrupt bytes.  The third
+    element is the recorded mesh topology (None for v1 envelopes)."""
     try:
         env = json.loads(raw.decode())
         body = json.dumps(env["state"], sort_keys=True, allow_nan=False)
         if hashlib.sha256(body.encode()).hexdigest() != env["sha256"]:
             return None
-        return env["state"], int(env["step"])
+        topology = env.get("mesh_topology")
+        return env["state"], int(env["step"]), topology if isinstance(topology, dict) else None
     except (ValueError, KeyError, TypeError, UnicodeDecodeError):
         return None
+
+
+def _check_topology(
+    expected: dict | None, found: dict | None, step: int
+) -> None:
+    """v1 envelopes (no recorded topology) and callers that don't care
+    (expected=None) always pass; otherwise compare JSON-normalized."""
+    if expected is None or found is None:
+        return
+    norm = lambda d: json.dumps(d, sort_keys=True)  # noqa: E731
+    if norm(expected) != norm(found):
+        raise TopologyMismatch(expected, found, step)
 
 
 @dataclass
@@ -227,11 +268,13 @@ class StateCheckpointer:
         steps = self.steps()
         return steps[-1] if steps else None
 
-    def save(self, step: int, state: dict) -> Path:
+    def save(
+        self, step: int, state: dict, mesh_topology: dict | None = None
+    ) -> Path:
         final = self._file(step)
         tmp = self._dir / f".{final.name}.tmp-{os.getpid()}"
         try:
-            self.io.write_bytes(tmp, _envelope(step, state))
+            self.io.write_bytes(tmp, _envelope(step, state, mesh_topology))
             self.io.replace(tmp, final)
         finally:
             # A torn write must not litter: the temp either renamed away
@@ -241,8 +284,14 @@ class StateCheckpointer:
         self._gc()
         return final
 
-    def restore_latest(self) -> tuple[dict, int] | None:
-        """Newest verifiable checkpoint, skipping any that fail the hash."""
+    def restore_latest(
+        self, expected_topology: dict | None = None
+    ) -> tuple[dict, int] | None:
+        """Newest verifiable checkpoint, skipping any that fail the hash.
+
+        ``expected_topology`` (a train/reshard.mesh_topology dict) makes a
+        cross-topology restore fail fast with :class:`TopologyMismatch`;
+        v1 envelopes carry no topology and are accepted unchanged."""
         for step in reversed(self.steps()):
             try:
                 raw = self.io.read_bytes(self._file(step))
@@ -250,7 +299,9 @@ class StateCheckpointer:
                 continue
             opened = _open_envelope(raw)
             if opened is not None:
-                return opened
+                state, found_step, topology = opened
+                _check_topology(expected_topology, topology, found_step)
+                return state, found_step
             log.warning(
                 "checkpoint step %d failed verification; skipping", step
             )
@@ -289,12 +340,16 @@ class ObjectStoreCheckpointer:
         steps = self.steps()
         return steps[-1] if steps else None
 
-    def save(self, step: int, state: dict) -> str:
+    def save(
+        self, step: int, state: dict, mesh_topology: dict | None = None
+    ) -> str:
         key = self._key(step)
-        self.store.put(key, _envelope(step, state))
+        self.store.put(key, _envelope(step, state, mesh_topology))
         return key
 
-    def restore_latest(self) -> tuple[dict, int] | None:
+    def restore_latest(
+        self, expected_topology: dict | None = None
+    ) -> tuple[dict, int] | None:
         for step in reversed(self.steps()):
             try:
                 raw = self.store.get(self._key(step))
@@ -302,7 +357,9 @@ class ObjectStoreCheckpointer:
                 continue
             opened = _open_envelope(bytes(raw))
             if opened is not None:
-                return opened
+                state, found_step, topology = opened
+                _check_topology(expected_topology, topology, found_step)
+                return state, found_step
         return None
 
 
@@ -340,7 +397,9 @@ class FallbackCheckpointer:
     def breaker(self, name: str) -> CircuitBreaker:
         return self._breakers[name]
 
-    def save(self, step: int, state: dict) -> str:
+    def save(
+        self, step: int, state: dict, mesh_topology: dict | None = None
+    ) -> str:
         """Write to the first healthy tier; returns the tier name used."""
         last_err: BaseException | None = None
         for name, tier in self.tiers:
@@ -348,7 +407,12 @@ class FallbackCheckpointer:
             if not breaker.allow():
                 continue
             try:
-                tier.save(step, state)
+                # Custom tiers predating envelope v2 may not accept the
+                # kwarg; only pass it when there is a topology to record.
+                if mesh_topology is not None:
+                    tier.save(step, state, mesh_topology=mesh_topology)
+                else:
+                    tier.save(step, state)
             except Exception as exc:
                 breaker.record_failure()
                 last_err = exc

@@ -1,0 +1,306 @@
+"""Live elastic resharding: survive a slice loss without a restart.
+
+The repo already proves the SLOW path — degrade → checkpoint →
+restore-on-smaller-mesh with loss continuity (tests/test_topology_restore).
+This module removes the restart: when the liveness plane publishes a
+coalesced slice loss (cluster/elasticity.TerminateDebouncer →
+cluster/recovery.LiveReshardManager), the trainer pauses at a step
+boundary (the ``reshard`` seam in ``Trainer.fit``), and the coordinator
+here:
+
+1. derives the surviving topology (``ClusterContract.surviving``),
+2. re-forms the mesh from it (caller-supplied ``mesh_for``),
+3. recomputes the sharding template with the SAME rules ``Trainer.init``
+   used (explicit specs remapped, heuristic FSDP re-inferred, optimizer
+   moments path-aligned via ``Trainer._opt_state_shardings``),
+4. migrates model + optimizer state **device-to-device** with
+   ``jax.device_put`` — pure data movement, bit-identical, no
+   object-store round-trip,
+5. rescales grad-accumulation so the global batch is preserved while the
+   per-device microbatch footprint stays constant, and
+6. rebinds the trainer (``Trainer.rebind_mesh``) so the next step
+   recompiles against the survivors — training resumes on the same batch
+   iterator with no step lost or repeated.
+
+Failure anywhere in 2-4 (or ``force_fallback``) degrades gracefully to
+the EXISTING checkpoint/restore path: the coordinator journals a
+``reshard_fallback`` event and returns ``"stop"``, so ``fit`` exits like
+an early stop and the caller runs a restore episode on the surviving
+mesh (docs/RESILIENCE.md, "fallback ladder").
+
+Timing comes from the injected ``clock`` (``time.monotonic`` by
+default, a virtual clock in chaos scenarios), never ``time.time()``
+arithmetic — the DLC205 rule applies to anything liveness-adjacent.
+Single-threaded by construction: everything runs on the training thread
+at the step boundary.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+
+from deeplearning_cfn_tpu.obs.recorder import get_recorder
+from deeplearning_cfn_tpu.obs.tracing import span
+from deeplearning_cfn_tpu.parallel.mesh import MeshError
+from deeplearning_cfn_tpu.parallel.sharding import infer_param_sharding, replicated
+from deeplearning_cfn_tpu.train.trainer import TrainState
+from deeplearning_cfn_tpu.utils.logging import get_logger
+
+log = get_logger("dlcfn.reshard")
+
+
+class ReshardError(RuntimeError):
+    """The surviving mesh cannot host the state live (indivisible shapes,
+    unmappable explicit specs, ...) — the coordinator degrades to the
+    checkpoint/restore fallback instead of crashing mid-step."""
+
+
+def mesh_topology(mesh: Mesh) -> dict:
+    """Canonical JSON-safe topology descriptor: device count plus the
+    non-trivial axis sizes.  Size-1 axes are dropped so a ``dp=1,fsdp=4``
+    mesh and a pure ``fsdp=4`` mesh over the same devices compare equal —
+    they host identical shardings.  Used by the checkpoint envelope
+    (train/checkpoint.py) and ``dlcfn status``."""
+    return {
+        "devices": int(mesh.size),
+        "axes": {str(k): int(v) for k, v in dict(mesh.shape).items() if int(v) > 1},
+    }
+
+
+def state_shardings_for(trainer: Any, state: TrainState, mesh: Mesh) -> TrainState:
+    """Recompute the TrainState sharding template for a new mesh with the
+    same rules ``Trainer.init`` applied to the old one: explicit param
+    specs are remapped name-for-name, heuristic FSDP is re-inferred from
+    the (unchanged) shapes, optimizer moments stay path-aligned via
+    ``Trainer._opt_state_shardings``, and model_state/step replicate."""
+    abstract_params = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state.params
+    )
+    explicit = getattr(trainer, "_explicit_param_shardings", None)
+    if explicit is not None:
+        try:
+            param_sh = jax.tree_util.tree_map(
+                lambda sh: NamedSharding(mesh, sh.spec), explicit
+            )
+        except (KeyError, ValueError, TypeError) as exc:
+            raise ReshardError(
+                f"explicit param shardings do not map onto the surviving mesh: {exc}"
+            ) from exc
+    elif trainer.config.strategy == "fsdp":
+        param_sh = infer_param_sharding(abstract_params, mesh)
+    else:
+        param_sh = jax.tree_util.tree_map(
+            lambda _: replicated(mesh), abstract_params
+        )
+    opt_sh = trainer._opt_state_shardings(abstract_params, param_sh, mesh=mesh)
+    model_state_sh = jax.tree_util.tree_map(
+        lambda _: replicated(mesh), state.model_state
+    )
+    return TrainState(
+        step=replicated(mesh),
+        params=param_sh,
+        opt_state=opt_sh,
+        model_state=model_state_sh,
+    )
+
+
+def ensure_hostable(state: Any, shardings: Any) -> None:
+    """Raise a typed :class:`ReshardError` (naming the leaf) when any
+    sharded dimension does not divide by its mesh-axis product — the
+    failure XLA would otherwise report as an opaque shape error from deep
+    inside ``device_put``."""
+
+    def check(path, x, sh):
+        spec = getattr(sh, "spec", None)
+        if spec is None:
+            return
+        axis_sizes = dict(sh.mesh.shape)
+        for dim, axes in enumerate(spec):
+            if axes is None:
+                continue
+            names = axes if isinstance(axes, tuple) else (axes,)
+            n = math.prod(axis_sizes[a] for a in names)
+            if dim >= getattr(x, "ndim", 0) or x.shape[dim] % n:
+                raise ReshardError(
+                    f"leaf {jax.tree_util.keystr(path)} shape "
+                    f"{tuple(getattr(x, 'shape', ()))} dim {dim} not divisible "
+                    f"by {n} on the surviving mesh"
+                )
+
+    jax.tree_util.tree_map_with_path(check, state, shardings)
+
+
+def migrate_state(state: TrainState, shardings: TrainState) -> TrainState:
+    """Repartition the full TrainState onto new shardings,
+    device-to-device.  ``device_put`` from one placement to another is
+    pure data movement — no arithmetic — so the result is bit-identical
+    to a fresh shard of the same values (tests/test_reshard.py golden
+    test), and nothing round-trips through host RAM beyond what the
+    runtime needs to re-split shards."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), state, shardings
+    )
+
+
+def rescale_grad_accum(accum: int, old_devices: int, new_devices: int) -> int:
+    """Grad-accumulation count that preserves the global batch on a
+    smaller mesh while keeping the per-device microbatch footprint no
+    larger than before: the same global batch now lands on fewer devices,
+    so each device sees ``old/new`` times more examples per step — split
+    the step into proportionally more microbatches.  8→4 devices at
+    accum=1 becomes accum=2; a grown mesh never *reduces* accum (that
+    would change a tuning choice behind the caller's back)."""
+    if new_devices <= 0:
+        raise ReshardError("surviving mesh has no devices")
+    if new_devices >= old_devices:
+        return accum
+    return max(1, math.ceil(accum * old_devices / new_devices))
+
+
+@dataclass
+class ReshardRecord:
+    """One pause-and-reshard episode, as journaled."""
+
+    step: int
+    mode: str  # "live" | "fallback"
+    old_topology: dict
+    new_topology: dict | None
+    grad_accum_before: int
+    grad_accum_after: int
+    seconds: float
+    reason: str | None = None
+
+
+@dataclass
+class LiveReshardCoordinator:
+    """The pause/reshard orchestrator handed to ``Trainer.fit(reshard=...)``.
+
+    ``pending()`` is polled at every step boundary: it pulls the
+    debounced slice-loss flush (``flush``, typically
+    ``controller.flush_slice_losses``) and reports whether the manager
+    armed.  ``execute(trainer, state, step)`` performs the live reshard
+    and returns ``(new_state, "resume")`` — or, on ``force_fallback`` or
+    any hosting failure, journals ``reshard_fallback`` and returns
+    ``(state, "stop")`` so the caller falls back to checkpoint/restore on
+    ``fallback_contract``.  Structural impossibilities (the coordinator's
+    own slice died, nothing survives) raise from
+    ``manager.surviving_contract()`` — there is no in-process path past
+    those."""
+
+    manager: Any  # cluster/recovery.LiveReshardManager (duck-typed)
+    mesh_for: Callable[[Any], Mesh]  # surviving contract -> Mesh
+    flush: Callable[[], Any] | None = None
+    clock: Callable[[], float] = time.monotonic
+    force_fallback: bool = False
+    records: list[ReshardRecord] = field(default_factory=list)
+    fallback_pending: bool = False
+    fallback_contract: Any = None
+
+    @property
+    def live_total(self) -> int:
+        return sum(1 for r in self.records if r.mode == "live")
+
+    @property
+    def fallback_total(self) -> int:
+        return sum(1 for r in self.records if r.mode == "fallback")
+
+    @property
+    def seconds_total(self) -> float:
+        return sum(r.seconds for r in self.records)
+
+    def pending(self) -> bool:
+        if self.fallback_pending:
+            return False
+        if self.flush is not None:
+            self.flush()
+        return bool(self.manager.needs_reshard)
+
+    def execute(self, trainer: Any, state: TrainState, step: int):
+        t0 = self.clock()
+        old_topology = mesh_topology(trainer.mesh)
+        old_devices = int(trainer.mesh.size)
+        old_accum = int(trainer.config.grad_accum_steps)
+        contract = self.manager.surviving_contract()
+        try:
+            if self.force_fallback:
+                raise ReshardError("forced fallback (chaos injection)")
+            new_mesh = self.mesh_for(contract)
+            shardings = state_shardings_for(trainer, state, new_mesh)
+            ensure_hostable(state, shardings)
+            with span("reshard", step=step):
+                new_state = migrate_state(state, shardings)
+            new_accum = rescale_grad_accum(old_accum, old_devices, int(new_mesh.size))
+            trainer.config.grad_accum_steps = new_accum
+            trainer.rebind_mesh(new_mesh, shardings)
+            self.manager.commit(contract)
+            record = ReshardRecord(
+                step=step,
+                mode="live",
+                old_topology=old_topology,
+                new_topology=mesh_topology(new_mesh),
+                grad_accum_before=old_accum,
+                grad_accum_after=new_accum,
+                seconds=self.clock() - t0,
+            )
+            self.records.append(record)
+            get_recorder().record(
+                "reshard",
+                step=step,
+                old_topology=old_topology,
+                new_topology=record.new_topology,
+                grad_accum_before=old_accum,
+                grad_accum_after=new_accum,
+                seconds=record.seconds,
+            )
+            if new_accum != old_accum:
+                get_recorder().record(
+                    "grad_accum_rescaled",
+                    step=step,
+                    before=old_accum,
+                    after=new_accum,
+                    global_batch_preserved=True,
+                )
+            log.warning(
+                "live reshard at step %d: %s -> %s (grad_accum %d -> %d)",
+                step,
+                old_topology,
+                record.new_topology,
+                old_accum,
+                new_accum,
+            )
+            return new_state, "resume"
+        except (ReshardError, MeshError, ValueError) as exc:
+            # Graceful degradation: the surviving topology is real even
+            # though the live path failed — commit it, stop the episode,
+            # and let the caller restore from checkpoint onto
+            # ``fallback_contract`` (the tier this path replaced).
+            self.fallback_pending = True
+            self.fallback_contract = contract
+            self.manager.commit(contract)
+            record = ReshardRecord(
+                step=step,
+                mode="fallback",
+                old_topology=old_topology,
+                new_topology=None,
+                grad_accum_before=old_accum,
+                grad_accum_after=old_accum,
+                seconds=self.clock() - t0,
+                reason=str(exc),
+            )
+            self.records.append(record)
+            get_recorder().record(
+                "reshard_fallback", step=step, reason=str(exc), seconds=record.seconds
+            )
+            log.warning(
+                "live reshard at step %d failed (%s); degrading to the "
+                "checkpoint/restore path",
+                step,
+                exc,
+            )
+            return state, "stop"

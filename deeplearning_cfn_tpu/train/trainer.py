@@ -414,7 +414,9 @@ class Trainer:
 
         return _init(rng, sample)
 
-    def _opt_state_shardings(self, abstract_params: Any, param_sh: Any) -> Any:
+    def _opt_state_shardings(
+        self, abstract_params: Any, param_sh: Any, mesh: Mesh | None = None
+    ) -> Any:
         """Optimizer state mirrors parameter sharding (moments are
         param-shaped); everything else (step counts, EMA scalars) is
         replicated.
@@ -426,7 +428,7 @@ class Trainer:
         adam moments, or XLA silently inserts resharding collectives on
         the moments every step."""
         opt_shape = jax.eval_shape(self.tx.init, abstract_params)
-        rep = replicated(self.mesh)
+        rep = replicated(mesh if mesh is not None else self.mesh)
         return optax.tree_map_params(
             self.tx,
             # Shape guard: factored-optimizer leaves (adafactor's
@@ -439,6 +441,20 @@ class Trainer:
             abstract_params,
             transform_non_params=lambda _leaf: rep,
         )
+
+    def rebind_mesh(self, mesh: Mesh, state_shardings: TrainState) -> None:
+        """Point the trainer at a new mesh with a matching sharding
+        template — the live-reshard seam (train/reshard.py).  The batch
+        spec is preserved (same axis names; our meshes always carry every
+        named axis, sized 1 where unused), and the cached jitted step and
+        eval functions are dropped so the next call recompiles against
+        the new topology.  The caller is responsible for having migrated
+        the actual TrainState onto ``state_shardings`` first."""
+        self.mesh = mesh
+        self.batch_sharding = NamedSharding(mesh, self.batch_sharding.spec)
+        self.state_shardings = state_shardings
+        self._step_fn = None
+        self._eval_fn = None
 
     # --- the step -------------------------------------------------------
     def _raw_step_fn(self):
@@ -724,6 +740,7 @@ class Trainer:
         stop_fn: Callable[[dict], bool] | None = None,
         prefetch: int = 2,
         prefetch_workers: int = 1,
+        reshard: Any = None,
     ) -> tuple[TrainState, list[float]]:
         """``stop_fn(metrics) -> True`` ends training early — the
         time-to-accuracy mode (the reference's only published CIFAR metric
@@ -752,6 +769,19 @@ class Trainer:
         time, stall/wait split) land on ``self.last_pipeline_stats``
         and are journaled via the obs plane as an ``input_pipeline``
         event (docs/PERFORMANCE.md).
+
+        ``reshard`` (a train/reshard.LiveReshardCoordinator, duck-typed)
+        is the elastic pause/resume seam: at every step boundary the
+        loop asks ``reshard.pending()``; when a coalesced slice loss is
+        waiting it drains the in-flight device scalars (they reference
+        the old mesh) and hands itself to ``reshard.execute``, which
+        migrates the state device-to-device and rebinds this trainer to
+        the surviving mesh.  ``"resume"`` continues on the SAME batch
+        iterator with the recompiled step — no step is lost or repeated;
+        ``"stop"`` (graceful degradation to the checkpoint/restore path)
+        breaks out, returning the partial losses like an early stop_fn
+        exit.  With a prefetcher, already-placed batches are simply
+        re-put onto the new mesh by device_put_tree.
         """
         from deeplearning_cfn_tpu.train.data import DevicePrefetcher
         from deeplearning_cfn_tpu.train.pipeline import PipelineStats
@@ -780,6 +810,17 @@ class Trainer:
         gstep = int(jax.device_get(state.step))
         try:
             for i, batch in enumerate(batches):
+                if reshard is not None and reshard.pending():
+                    # Pause at the step boundary: settle the losses already
+                    # dispatched against the old mesh, then migrate.  The
+                    # batch just pulled is trained on the NEW mesh below —
+                    # the data stream continues unbroken.
+                    losses.extend(float(v) for v in jax.device_get(pending))
+                    pending.clear()
+                    state, action = reshard.execute(self, state, step=gstep)
+                    if action == "stop":
+                        break
+                    step_fn = self.step_fn
                 # Targets may be a pytree (e.g. detection {boxes, classes});
                 # every leaf leads with the batch axis, so one batch sharding
                 # applies uniformly.  device_put_tree skips leaves the

@@ -13,7 +13,10 @@ python -m deeplearning_cfn_tpu.cli lint --concurrency --protocol \
   --baseline scripts/lint_baseline.json || exit 1
 
 echo "== chaos scenarios (seeded, virtual-clock — docs/RESILIENCE.md) =="
-JAX_PLATFORMS=cpu python -m deeplearning_cfn_tpu.cli chaos --all --seed 0 \
+# --all includes slice-loss-live, which drives a real 2-slice SPMD trainer
+# and needs 8 virtual CPU devices before the JAX backend initializes.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python -m deeplearning_cfn_tpu.cli chaos --all --seed 0 \
   > /tmp/_chaos.json || { cat /tmp/_chaos.json; exit 1; }
 echo "chaos: all scenarios held their invariants (report: /tmp/_chaos.json)"
 

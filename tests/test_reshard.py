@@ -1,0 +1,496 @@
+"""Live elastic resharding: debounce, topology derivation, migration
+numerics, envelope versioning, and the fit() pause/resume seam.
+
+The golden test here is the numerics contract the whole feature rests
+on: repartitioning optimizer state across 8 -> 4 simulated devices via
+``migrate_state`` (device-to-device ``device_put``) must be
+BIT-identical to freshly sharding the same host pytree — pure data
+movement, no arithmetic.  The chaos scenario (tests/test_chaos.py runs
+``slice-loss-live`` automatically) covers the end-to-end continuity
+story; the ``@slow`` soak below widens it to >= 5 seeds with
+byte-identical reports.
+"""
+
+import argparse
+import json
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_cfn_tpu.analysis.schedules import VirtualClock, interleavings
+from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+from deeplearning_cfn_tpu.cluster.elasticity import (
+    ElasticityController,
+    GroupPolicy,
+    TerminateDebouncer,
+)
+from deeplearning_cfn_tpu.cluster.recovery import LiveReshardManager
+from deeplearning_cfn_tpu.parallel.mesh import (
+    MeshSpec,
+    build_mesh,
+    hybrid_mesh_for_slices,
+    virtual_cpu_devices,
+)
+from deeplearning_cfn_tpu.parallel.sharding import shard_pytree
+from deeplearning_cfn_tpu.provision.events import EventBus, EventKind, LifecycleEvent
+from deeplearning_cfn_tpu.train.checkpoint import StateCheckpointer, TopologyMismatch
+from deeplearning_cfn_tpu.train.reshard import (
+    LiveReshardCoordinator,
+    ReshardError,
+    ensure_hostable,
+    mesh_topology,
+    migrate_state,
+    rescale_grad_accum,
+    state_shardings_for,
+)
+from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
+
+
+class _MLP(nn.Module):
+    # fc2's 256x256 kernel clears the FSDP min_shard_elems heuristic, so
+    # these tests move genuinely fsdp-sharded arrays, not replicas.
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(256, name="fc1")(x))
+        x = nn.relu(nn.Dense(256, name="fc2")(x))
+        return nn.Dense(10, name="head")(x)
+
+
+class _Backend:
+    def __init__(self):
+        self.events = EventBus()
+
+
+def _terminate(group, instance):
+    return LifecycleEvent(
+        kind=EventKind.INSTANCE_TERMINATE, group=group, instance_id=instance
+    )
+
+
+def _contract():
+    return ClusterContract.build(
+        cluster_name="live",
+        coordinator_ip="10.0.0.1",
+        other_worker_ips=["10.0.0.2", "10.0.0.3", "10.0.0.4"],
+        chips_per_worker=2,
+        storage_mount="/mnt/none",
+        slices={
+            "s0": ["10.0.0.1", "10.0.0.2"],
+            "s1": ["10.0.0.3", "10.0.0.4"],
+        },
+    )
+
+
+def _controller(vclock, window_s=10.0):
+    controller = ElasticityController(
+        backend=_Backend(),
+        coordinator_queue_name="coord",
+        slice_loss_window_s=window_s,
+        clock=vclock,
+    )
+    controller.register(GroupPolicy("s0", 1, "sig-s0", coordinator=True))
+    controller.register(GroupPolicy("s1", 1, "sig-s1"))
+    controller.attach()
+    return controller
+
+
+# --- debounce ---------------------------------------------------------------
+
+
+def test_terminate_burst_coalesces_across_interleavings():
+    """A multi-host slice death (3 events incl. a duplicate, interleaved
+    with clock ticks that stay inside the window) must flush as exactly
+    ONE slice-loss with the deduplicated instance set — for every
+    seeded interleaving of the burst."""
+    actions = ["term:h3", "term:h4", "term:h3", "tick", "tick"]
+    for schedule in interleavings(actions, count=10, seed=7):
+        vclock = VirtualClock()
+        controller = _controller(vclock)
+        fired = []
+        controller.on_slice_loss = lambda g, burst: fired.append(
+            (g, sorted(e.instance_id for e in burst))
+        )
+        for action in schedule:
+            kind, _, arg = action.partition(":")
+            if kind == "term":
+                controller.backend.events.publish(_terminate("s1", arg))
+            else:
+                vclock.advance(3.0)  # 2 ticks = 6s < the 10s window
+            controller.flush_slice_losses()
+        assert fired == [], f"window must not elapse mid-burst: {schedule}"
+        vclock.advance(10.0)
+        assert controller.flush_slice_losses() == ["s1"]
+        assert fired == [("s1", ["h3", "h4"])], f"schedule {schedule}"
+
+
+def test_separate_bursts_are_separate_flushes():
+    vclock = VirtualClock()
+    debounce = TerminateDebouncer(window_s=5.0, clock=vclock)
+    debounce.observe("s1", _terminate("s1", "a"))
+    vclock.advance(6.0)
+    first = debounce.flush()
+    debounce.observe("s1", _terminate("s1", "b"))
+    vclock.advance(6.0)
+    second = debounce.flush()
+    assert [g for g, _ in first] == ["s1"]
+    assert [g for g, _ in second] == ["s1"]
+    assert [e.instance_id for _, b in second for e in b] == ["b"]
+
+
+def test_debounce_flushes_per_group():
+    vclock = VirtualClock()
+    debounce = TerminateDebouncer(window_s=5.0, clock=vclock)
+    debounce.observe("s1", _terminate("s1", "a"))
+    debounce.observe("s2", _terminate("s2", "b"))
+    assert debounce.flush() == []  # window not elapsed
+    flushed = dict(debounce.flush(force=True))
+    assert set(flushed) == {"s1", "s2"}
+
+
+# --- surviving topology -----------------------------------------------------
+
+
+def test_surviving_drops_lost_slice_and_degrades():
+    contract = _contract()
+    contract.tags = {"env": "test"}
+    survivor = contract.surviving({"s1"})
+    assert survivor.slices == {"s0": ["10.0.0.1", "10.0.0.2"]}
+    assert survivor.worker_ips == ["10.0.0.1", "10.0.0.2"]
+    assert survivor.degraded
+    assert survivor.coordinator_ip == "10.0.0.1"
+    assert survivor.tags == {"env": "test"}
+    assert survivor.coordinator_port == contract.coordinator_port
+
+
+def test_surviving_structural_failures():
+    contract = _contract()
+    with pytest.raises(ValueError, match="coordinator"):
+        contract.surviving({"s0"})  # process 0's slice died
+    with pytest.raises(ValueError, match="none of"):
+        contract.surviving({"bogus"})
+    with pytest.raises(ValueError):
+        contract.surviving({"s0", "s1"})  # nothing survives
+    flat = ClusterContract.build(
+        cluster_name="flat",
+        coordinator_ip="10.0.0.1",
+        other_worker_ips=["10.0.0.2"],
+        chips_per_worker=2,
+        storage_mount="/mnt/none",
+    )
+    with pytest.raises(ValueError, match="topology"):
+        flat.surviving({"s1"})
+
+
+def test_live_reshard_manager_is_idempotent():
+    manager = LiveReshardManager(_contract())
+    manager.on_slice_loss("s1", [_terminate("s1", "a")])
+    manager.on_slice_loss("s1", [_terminate("s1", "a")])  # duplicate flush
+    manager.on_slice_loss("ghost", [_terminate("ghost", "z")])  # unknown
+    assert manager.lost_groups == {"s1"}
+    survivor = manager.surviving_contract()
+    manager.commit(survivor)
+    assert not manager.needs_reshard
+    # After commit the group is gone from the topology: stale re-delivery
+    # must not re-arm.
+    manager.on_slice_loss("s1", [_terminate("s1", "a")])
+    assert not manager.needs_reshard
+
+
+# --- reshard numerics -------------------------------------------------------
+
+
+def test_rescale_grad_accum_preserves_global_batch():
+    assert rescale_grad_accum(1, 8, 4) == 2
+    assert rescale_grad_accum(3, 8, 4) == 6
+    assert rescale_grad_accum(1, 8, 3) == 3  # ceil keeps footprint bounded
+    assert rescale_grad_accum(2, 8, 8) == 2
+    assert rescale_grad_accum(2, 4, 8) == 2  # growth never shrinks accum
+    with pytest.raises(ReshardError):
+        rescale_grad_accum(1, 8, 0)
+
+
+def test_opt_state_repartition_8_to_4_bit_identical():
+    """The golden numerics contract: migrating live state down to half
+    the devices equals a FRESH shard of the same host pytree, byte for
+    byte — device_put moves data, it never does arithmetic."""
+    devices = virtual_cpu_devices(8)
+    mesh8 = build_mesh(MeshSpec.fsdp_parallel(8), devices)
+    mesh4 = build_mesh(MeshSpec.fsdp_parallel(4), devices[:4])
+    trainer = Trainer(
+        _MLP(),
+        mesh8,
+        TrainerConfig(
+            optimizer="adamw",
+            learning_rate=1e-3,
+            strategy="fsdp",
+            matmul_precision="float32",
+            log_every=1,
+        ),
+    )
+    sample = np.zeros((8, 8, 8, 1), np.float32)
+    state = trainer.init(jax.random.PRNGKey(0), sample)
+    # Two real steps so adam moments are non-trivial.
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 8, 8, 1)).astype(np.float32)
+    y = rng.integers(0, 10, size=(32,))
+    for _ in range(2):
+        state, _ = trainer.train_step(
+            state, jnp.asarray(x), jnp.asarray(y)
+        )
+
+    shardings4 = state_shardings_for(trainer, state, mesh4)
+    ensure_hostable(state, shardings4)
+    migrated = migrate_state(state, shardings4)
+    host = jax.device_get(state)
+    fresh = shard_pytree(host, shardings4)
+
+    sharded_leaves = 0
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(migrated),
+        jax.tree_util.tree_leaves_with_path(fresh),
+    ):
+        assert a.sharding == b.sharding, path
+        assert a.dtype == b.dtype, path
+        assert (
+            np.asarray(jax.device_get(a)).tobytes()
+            == np.asarray(jax.device_get(b)).tobytes()
+        ), f"repartition not bit-identical at {jax.tree_util.keystr(path)}"
+        if "fsdp" in str(getattr(a.sharding, "spec", "")):
+            sharded_leaves += 1
+    assert sharded_leaves >= 2, "expected genuinely fsdp-sharded params+moments"
+    assert mesh_topology(mesh4) == {"devices": 4, "axes": {"fsdp": 4}}
+
+
+def test_ensure_hostable_raises_typed_error():
+    devices = virtual_cpu_devices(8)
+    mesh3 = build_mesh(MeshSpec.fsdp_parallel(3), devices[:3])
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    state = {"w": np.zeros((256, 256), np.float32)}
+    bad = {"w": NamedSharding(mesh3, P("fsdp", None))}
+    # 256 % 3 != 0: the typed error must name the leaf, not crash in XLA.
+    with pytest.raises(ReshardError, match="w"):
+        ensure_hostable(state, bad)
+
+
+# --- checkpoint envelope topology ------------------------------------------
+
+
+def test_envelope_topology_roundtrip_and_mismatch(tmp_path):
+    ck = StateCheckpointer(tmp_path)
+    topo8 = {"devices": 8, "axes": {"fsdp": 8}}
+    topo4 = {"devices": 4, "axes": {"fsdp": 4}}
+    ck.save(3, {"loss": 0.5}, mesh_topology=topo8)
+    assert ck.restore_latest() == ({"loss": 0.5}, 3)
+    assert ck.restore_latest(expected_topology=topo8) == ({"loss": 0.5}, 3)
+    with pytest.raises(TopologyMismatch) as err:
+        ck.restore_latest(expected_topology=topo4)
+    assert err.value.expected == topo4
+    assert err.value.found == topo8
+    assert err.value.step == 3
+
+
+def test_envelope_v1_reads_are_backward_compatible(tmp_path):
+    ck = StateCheckpointer(tmp_path)
+    ck.save(1, {"loss": 0.9})  # v1: no topology recorded
+    raw = json.loads((ck._file(1)).read_text())
+    assert "mesh_topology" not in raw and "version" not in raw
+    # A v1 envelope restores under ANY expected topology (unconstrained).
+    assert ck.restore_latest(
+        expected_topology={"devices": 4, "axes": {"fsdp": 4}}
+    ) == ({"loss": 0.9}, 1)
+
+
+# --- the fit() seam ---------------------------------------------------------
+
+
+def _mesh_for_factory(devices):
+    def mesh_for(contract):
+        n = contract.slices_count
+        per_slice = contract.total_chips // max(n, 1)
+        return hybrid_mesh_for_slices(
+            n,
+            ici_spec=MeshSpec.fsdp_parallel(per_slice),
+            dcn_axis="dp",
+            devices=devices[: contract.total_chips],
+        )
+
+    return mesh_for
+
+
+def _live_setup(force_fallback=False):
+    devices = virtual_cpu_devices(8)
+    vclock = VirtualClock()
+    controller = _controller(vclock)
+    manager = LiveReshardManager(_contract())
+    manager.attach(controller)
+    coordinator = LiveReshardCoordinator(
+        manager=manager,
+        mesh_for=_mesh_for_factory(devices),
+        flush=controller.flush_slice_losses,
+        clock=vclock,
+        force_fallback=force_fallback,
+    )
+    trainer = Trainer(
+        _MLP(),
+        coordinator.mesh_for(manager.contract),
+        TrainerConfig(
+            optimizer="adamw",
+            learning_rate=1e-3,
+            strategy="fsdp",
+            matmul_precision="float32",
+            log_every=1,
+        ),
+    )
+    return controller, manager, coordinator, trainer, vclock
+
+
+def _batches(steps, die_at, controller, vclock):
+    rng = np.random.default_rng(1)
+    from deeplearning_cfn_tpu.train.data import Batch
+
+    for i in range(steps):
+        if i == die_at:
+            for ip in ("10.0.0.3", "10.0.0.4"):
+                controller.backend.events.publish(_terminate("s1", ip))
+            vclock.advance(11.0)
+        yield Batch(
+            x=rng.normal(size=(32, 8, 8, 1)).astype(np.float32),
+            y=rng.integers(0, 10, size=(32,)),
+        )
+
+
+def test_fit_survives_slice_loss_live():
+    controller, manager, coordinator, trainer, vclock = _live_setup()
+    state = trainer.init(
+        jax.random.PRNGKey(0), np.zeros((8, 8, 8, 1), np.float32)
+    )
+    state, losses = trainer.fit(
+        state,
+        _batches(6, 2, controller, vclock),
+        steps=6,
+        prefetch=0,
+        reshard=coordinator,
+    )
+    assert len(losses) == 6
+    assert int(jax.device_get(state.step)) == 6
+    assert coordinator.live_total == 1 and coordinator.fallback_total == 0
+    assert mesh_topology(trainer.mesh) == {"devices": 4, "axes": {"fsdp": 4}}
+    assert trainer.config.grad_accum_steps == 2
+    assert manager.contract.slices_count == 1 and manager.contract.degraded
+    # The migrated state really lives on the surviving mesh.
+    kernel = state.params["fc2"]["kernel"]
+    assert len(kernel.sharding.device_set) == 4
+    assert all(np.isfinite(v) for v in losses)
+
+
+def test_fit_degrades_to_fallback_stop():
+    from deeplearning_cfn_tpu.obs.recorder import get_recorder
+
+    controller, manager, coordinator, trainer, vclock = _live_setup(
+        force_fallback=True
+    )
+    state = trainer.init(
+        jax.random.PRNGKey(0), np.zeros((8, 8, 8, 1), np.float32)
+    )
+    before = sum(
+        1
+        for e in get_recorder().tail(4096)
+        if e.get("kind") == "reshard_fallback"
+    )
+    state, losses = trainer.fit(
+        state,
+        _batches(6, 2, controller, vclock),
+        steps=6,
+        prefetch=0,
+        reshard=coordinator,
+    )
+    # Graceful degradation: a clean early exit with the pre-pause losses,
+    # never an exception; the caller restores from checkpoint onto
+    # fallback_contract (the chaos scenario drives that full path).
+    assert len(losses) == 2
+    assert int(jax.device_get(state.step)) == 2
+    assert coordinator.fallback_pending
+    assert coordinator.fallback_contract.slices_count == 1
+    assert coordinator.records[-1].mode == "fallback"
+    after = sum(
+        1
+        for e in get_recorder().tail(4096)
+        if e.get("kind") == "reshard_fallback"
+    )
+    assert after - before == 1
+
+
+# --- status / exporter surfacing -------------------------------------------
+
+
+def test_fold_and_render_reshard_metrics():
+    from deeplearning_cfn_tpu.obs.exporter import (
+        fold_reshard_events,
+        render_prometheus,
+    )
+
+    events = [
+        {"kind": "reshard", "step": 4, "seconds": 0.25, "grad_accum_after": 2},
+        {"kind": "reshard_fallback", "step": 9, "reason": "x"},
+        {"kind": "span", "span": "train_step"},
+    ]
+    folded = fold_reshard_events(events)
+    assert folded["total"] == 1
+    assert folded["fallback_total"] == 1
+    assert folded["seconds_total"] == 0.25
+    assert folded["last"]["step"] == 4
+    assert fold_reshard_events([{"kind": "span"}]) == {}
+
+    text = render_prometheus(
+        reshard=folded,
+        mesh={"slices": 1, "workers": 2, "chips_total": 4},
+        cluster="live",
+    )
+    assert 'dlcfn_reshard_total{cluster="live"} 1' in text
+    assert 'dlcfn_reshard_seconds{cluster="live"} 0.25' in text
+    assert 'dlcfn_reshard_fallback_total{cluster="live"} 1' in text
+    assert 'dlcfn_mesh_slices{cluster="live"} 1' in text
+    assert 'dlcfn_mesh_chips_total{cluster="live"} 4' in text
+
+
+def test_status_mesh_reads_contract(tmp_path, monkeypatch):
+    from deeplearning_cfn_tpu.cli import _status_mesh
+
+    monkeypatch.setenv("DLCFN_ROOT", str(tmp_path))
+    contract = _contract()
+    contract.write(tmp_path)
+    args = argparse.Namespace(cluster="live")
+    mesh = _status_mesh(args)
+    assert mesh == {
+        "cluster": "live",
+        "slices": 2,
+        "workers": 4,
+        "chips_total": 8,
+        "degraded": False,
+        "slice_groups": {"s0": 2, "s1": 2},
+    }
+    assert _status_mesh(argparse.Namespace(cluster="other")) is None
+    assert _status_mesh(argparse.Namespace(cluster="")) is None
+
+
+# --- multi-seed soak --------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(5))
+def test_slice_loss_live_soak_byte_identical(seed):
+    """>= 5 seeds, each run twice: every invariant holds and the report
+    is byte-identical per seed (the chaos determinism contract)."""
+    from deeplearning_cfn_tpu.chaos.scenarios import run_scenario
+
+    first = run_scenario("slice-loss-live", seed).to_dict()
+    second = run_scenario("slice-loss-live", seed).to_dict()
+    assert first["passed"], first["violations"]
+    assert json.dumps(first, sort_keys=True) == json.dumps(
+        second, sort_keys=True
+    )
