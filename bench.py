@@ -111,6 +111,10 @@ def measure_input_pipeline(trainer, state, batch: int, n_chips: int) -> dict:
 
 
 def main() -> None:
+    from deeplearning_cfn_tpu.analysis.compile_audit import (
+        CompileWatcher,
+        measure_donation,
+    )
     from deeplearning_cfn_tpu.examples.common import enable_compile_cache
     from deeplearning_cfn_tpu.models.resnet import ResNet50
     from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
@@ -142,44 +146,74 @@ def main() -> None:
     x = jax.device_put(jnp.asarray(x, jnp.bfloat16), trainer.batch_sharding)
     y = jax.device_put(jnp.asarray(y), trainer.batch_sharding)
 
-    state = trainer.init(jax.random.key(0), x)
-    # Cost analysis before any donated execution: flops per compiled step
-    # is the MFU numerator.
-    stats = trainer.compile_stats(state, x, y)
-    flops_per_step = stats.get("flops_per_step")
+    # The watcher turns the whole bench into its own compile audit:
+    # per-function compile counts from the jax_log_compiles stream, so a
+    # retrace silently eating the timed window shows up as
+    # retrace_count > 0 in the JSON instead of as an unexplained MFU dip
+    # (docs/STATIC_ANALYSIS.md retrace runbook).
+    with CompileWatcher() as watcher:
+        state = trainer.init(jax.random.key(0), x)
+        # Cost analysis before any donated execution: flops per compiled
+        # step is the MFU numerator.
+        stats = trainer.compile_stats(state, x, y)
+        flops_per_step = stats.get("flops_per_step")
 
-    step = trainer.step_fn
-    for _ in range(WARMUP_STEPS):
-        state, metrics = step(state, x, y)
-    # float() forces a device->host readback through the whole step chain —
-    # block_until_ready alone proved unreliable on relayed PJRT backends.
-    float(metrics["loss"])
+        step = trainer.step_fn
+        # The ambient mesh is part of the jit cache key: compile_stats
+        # AOT-compiles under set_mesh, so dispatching bare here would
+        # miss that cache entry and pay the full ResNet-50 compile a
+        # second time (this run's own compile audit caught exactly that:
+        # step_fn compiled twice until the phase moved under set_mesh).
+        with set_mesh(trainer.mesh):
+            for _ in range(WARMUP_STEPS):
+                state, metrics = step(state, x, y)
+            # float() forces a device->host readback through the whole
+            # step chain — block_until_ready alone proved unreliable on
+            # relayed PJRT backends.
+            float(metrics["loss"])
+            # One extra untimed step proving the state buffers actually
+            # get donated (is_deleted after dispatch): donated_bytes == 0
+            # means the step holds two state copies live.
+            (state, metrics), donation = measure_donation(step, state, x, y)
 
-    t0 = time.perf_counter()
-    for _ in range(MEASURE_STEPS):
-        state, metrics = step(state, x, y)
-    final_loss = float(metrics["loss"])
-    dt = time.perf_counter() - t0
-    assert np.isfinite(final_loss)
-    single_step_per_chip = batch * MEASURE_STEPS / dt / n_chips
+            t0 = time.perf_counter()
+            for _ in range(MEASURE_STEPS):
+                state, metrics = step(state, x, y)
+            final_loss = float(metrics["loss"])
+            dt = time.perf_counter() - t0
+        assert np.isfinite(final_loss)
+        single_step_per_chip = batch * MEASURE_STEPS / dt / n_chips
 
-    # Headline mode: k iterations per compiled program (see STEPS_PER_CALL).
-    k = STEPS_PER_CALL
-    with set_mesh(trainer.mesh):
-        kfn = trainer.multi_step_fn(k)
-        xs = jnp.broadcast_to(x, (k, *x.shape))
-        ys = jnp.broadcast_to(y, (k, *y.shape))
-        for _ in range(max(1, WARMUP_STEPS // k)):
-            state, losses = kfn(state, xs, ys)
-        float(np.asarray(jax.device_get(losses))[-1])
-        outer = max(1, MEASURE_STEPS // k)
-        t0 = time.perf_counter()
-        for _ in range(outer):
-            state, losses = kfn(state, xs, ys)
-        final_loss = float(np.asarray(jax.device_get(losses))[-1])
-        dt = time.perf_counter() - t0
-    assert np.isfinite(final_loss)
-    multi_step_per_chip = batch * outer * k / dt / n_chips
+        # Headline mode: k iterations per compiled program (STEPS_PER_CALL).
+        k = STEPS_PER_CALL
+        with set_mesh(trainer.mesh):
+            kfn = trainer.multi_step_fn(k)
+
+            # One named jit for both broadcasts: done bare, each
+            # jnp.broadcast_to dispatches its own anonymous
+            # "broadcast_in_dim" program and the pair reads as a retrace
+            # in the compile audit (same op name, two avals).
+            @jax.jit
+            def stack_k(a, b):
+                return (
+                    jnp.broadcast_to(a, (k, *a.shape)),
+                    jnp.broadcast_to(b, (k, *b.shape)),
+                )
+
+            xs, ys = stack_k(x, y)
+            for _ in range(max(1, WARMUP_STEPS // k)):
+                state, losses = kfn(state, xs, ys)
+            float(np.asarray(jax.device_get(losses))[-1])
+            outer = max(1, MEASURE_STEPS // k)
+            t0 = time.perf_counter()
+            for _ in range(outer):
+                state, losses = kfn(state, xs, ys)
+            final_loss = float(np.asarray(jax.device_get(losses))[-1])
+            dt = time.perf_counter() - t0
+        assert np.isfinite(final_loss)
+        multi_step_per_chip = batch * outer * k / dt / n_chips
+
+        pipeline = measure_input_pipeline(trainer, state, batch, n_chips)
     # Both modes are honest measurements and BOTH are reported (the old
     # harness silently dropped the loser); the headline is the better one,
     # since relay variance can invert the expected ordering on a bad draw.
@@ -195,8 +229,6 @@ def main() -> None:
             f"single_step ({single_step_per_chip:.0f}) beat "
             f"multi_step_k{k} ({multi_step_per_chip:.0f}) on this draw"
         )
-
-    pipeline = measure_input_pipeline(trainer, state, batch, n_chips)
 
     from deeplearning_cfn_tpu.train.metrics import peak_flops_per_chip
 
@@ -225,6 +257,13 @@ def main() -> None:
                     multi_step_per_chip, 2
                 ),
                 "input_pipeline": pipeline,
+                # Compile-behavior correlates for the MFU trajectory
+                # (ISSUE 7): total XLA compiles this run, compiles beyond
+                # the first per function (0 = steady-state zero-retrace),
+                # and state bytes the step actually donated.
+                "compile_count": watcher.compile_count,
+                "retrace_count": watcher.retrace_count,
+                "donated_bytes": donation.donated_bytes,
                 "flops_per_step": flops_per_step,
                 "device_kind": str(getattr(devices[0], "device_kind", "unknown")),
                 "n_chips": n_chips,

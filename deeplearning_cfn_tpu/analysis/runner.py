@@ -9,6 +9,7 @@ from typing import Iterable, Iterator
 # Importing rule modules registers them in core.FILE_RULES.
 import deeplearning_cfn_tpu.analysis.concurrency as concurrency_rules
 import deeplearning_cfn_tpu.analysis.rules  # noqa: F401
+import deeplearning_cfn_tpu.analysis.sharding as sharding_rules
 from deeplearning_cfn_tpu.analysis import contract_check, protocol
 from deeplearning_cfn_tpu.analysis.core import FILE_RULES, Violation, lint_source
 
@@ -47,6 +48,7 @@ def run_lint(
     contract: bool = True,
     concurrency: bool = False,
     protocol_pass: bool = False,
+    sharding: bool = False,
 ) -> list[Violation]:
     """Lint the given targets (repo defaults when None).
 
@@ -57,16 +59,22 @@ def run_lint(
     The DLC2xx concurrency rules are gated: they run when
     ``concurrency=True`` or a ``select`` names them, never implicitly.
     Likewise the DLC3xx protocol/lifecycle checkers run when
-    ``protocol_pass=True`` or selected.
+    ``protocol_pass=True`` or selected, and the DLC4xx trace-safety
+    rules when ``sharding=True`` or selected.
     """
     effective_select = select
-    if select is None and concurrency:
+    gated_ids: set[str] = set()
+    if concurrency:
+        gated_ids |= set(concurrency_rules.RULE_IDS)
+    if sharding:
+        gated_ids |= set(sharding_rules.RULE_IDS)
+    if select is None and gated_ids:
         # Widen the per-file selection to "every ungated rule plus the
-        # concurrency pass" — an explicit select is what lets gated rules
-        # through core.lint_source.
+        # requested gated passes" — an explicit select is what lets gated
+        # rules through core.lint_source.
         effective_select = {
             rule.id for rule in FILE_RULES.values() if rule.gate is None
-        } | set(concurrency_rules.RULE_IDS)
+        } | gated_ids
 
     out: list[Violation] = []
     for path in discover(targets if targets is not None else DEFAULT_TARGETS, root):

@@ -903,7 +903,8 @@ def cmd_lint(args) -> int:
     Runs the DLC0xx per-file AST rules over the package + scripts and the
     DLC1xx cross-language broker-contract checker; ``--concurrency`` adds
     the DLC2xx lockset rules, ``--protocol`` the DLC3xx message-shape
-    checkers.  Exit 1 on findings not covered by ``--baseline``."""
+    checkers, ``--sharding`` the DLC4xx JAX/SPMD trace-safety rules.
+    Exit 1 on findings not covered by ``--baseline``."""
     from deeplearning_cfn_tpu.analysis.runner import (
         DEFAULT_BASELINE,
         apply_baseline,
@@ -922,6 +923,7 @@ def cmd_lint(args) -> int:
         select=select,
         concurrency=args.concurrency,
         protocol_pass=args.protocol,
+        sharding=args.sharding,
     )
 
     baseline_path = args.baseline
@@ -1109,12 +1111,16 @@ def main(argv: list[str] | None = None) -> int:
                     metavar="RULES",
                     help="comma-separated rule ids to run (e.g. "
                          "DLC001,DLC100); default: all ungated rules. "
-                         "Naming a gated id (DLC2xx/DLC3xx) enables it.")
+                         "Naming a gated id (DLC2xx/DLC3xx/DLC4xx) "
+                         "enables it.")
     pl.add_argument("--concurrency", action="store_true",
                     help="also run the DLC2xx lockset/thread-escape rules")
     pl.add_argument("--protocol", action="store_true",
                     help="also run the DLC3xx broker message-shape and "
                          "lifecycle-kind checkers")
+    pl.add_argument("--sharding", action="store_true",
+                    help="also run the DLC4xx JAX/SPMD trace-safety rules "
+                         "(retrace/donation/mesh-axis/host-sync)")
     pl.add_argument("--baseline", nargs="?", metavar="PATH", default=None,
                     const=_BASELINE_DEFAULT_SENTINEL,
                     help="suppress findings recorded in this baseline file "

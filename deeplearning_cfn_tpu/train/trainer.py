@@ -871,7 +871,11 @@ class Trainer:
         ``flops_per_step`` is PER-DEVICE for an SPMD-partitioned module
         (each device executes the partitioned program over its batch
         shard) — pair it with the per-chip peak for MFU.  The compile
-        populates the jit dispatch cache, so it is not paid twice.
+        populates the jit dispatch cache, so it is not paid twice —
+        PROVIDED later dispatches also run under ``set_mesh(self.mesh)``
+        (train_step/fit do): the ambient mesh is part of the jit cache
+        key, so a bare ``step_fn(state, x, y)`` call after this misses
+        the entry and recompiles (scripts/compile_audit.py catches it).
 
         When the model supplies ``analytic_flops_fn``, ``flops_per_step``
         is the analytic estimate (divided down to per-device scope) and

@@ -2,14 +2,16 @@
 # One gate, two halves: the repo-native lint pass (dlcfn lint with every
 # gated pass on — DLC0xx per-file rules, DLC1xx broker-contract checker,
 # DLC2xx concurrency lockset rules, DLC3xx message-shape/lifecycle
-# checkers — ratcheted against the committed suppression baseline) then
-# the tier-1 test suite — exactly the commands ROADMAP.md designates, so
-# CI and a developer's pre-push run cannot drift apart.
+# checkers, DLC4xx JAX/SPMD trace-safety rules — ratcheted against the
+# committed suppression baseline) then the dynamic gates (chaos,
+# perf-smoke, compile-audit) and the tier-1 test suite — exactly the
+# commands ROADMAP.md designates, so CI and a developer's pre-push run
+# cannot drift apart.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== dlcfn lint (full: --concurrency --protocol, baselined) =="
-python -m deeplearning_cfn_tpu.cli lint --concurrency --protocol \
+echo "== dlcfn lint (full: --concurrency --protocol --sharding, baselined) =="
+python -m deeplearning_cfn_tpu.cli lint --concurrency --protocol --sharding \
   --baseline scripts/lint_baseline.json || exit 1
 
 echo "== chaos scenarios (seeded, virtual-clock — docs/RESILIENCE.md) =="
@@ -22,6 +24,15 @@ echo "chaos: all scenarios held their invariants (report: /tmp/_chaos.json)"
 
 echo "== perf-smoke (compact-dtype input path, structural asserts only) =="
 JAX_PLATFORMS=cpu python scripts/perf_smoke.py || exit 1
+
+echo "== compile-audit sentinel (steady-state zero-retrace + donation) =="
+# Real Trainer.fit() + multi-step path on CPU: any function recompiling
+# after warmup (DLC410) or a step donating zero bytes (DLC411) fails here
+# unless baselined (docs/STATIC_ANALYSIS.md retrace runbook).
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python scripts/compile_audit.py --baseline scripts/lint_baseline.json \
+  > /tmp/_compile_audit.json || { cat /tmp/_compile_audit.json; exit 1; }
+echo "compile-audit: steady-state zero retrace, donation effective (report: /tmp/_compile_audit.json)"
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

@@ -1,0 +1,76 @@
+"""Compile-audit CI stage: steady-state zero-retrace, proven by running.
+
+Runs the real ``Trainer.fit()`` single-step path and the bench
+multi-step path for a few CPU steps under a
+:class:`analysis.compile_audit.CompileWatcher`, then applies the same
+suppression-baseline ratchet as ``dlcfn lint`` (scripts/lint_baseline.json):
+
+- a function that recompiles after warmup -> DLC410 finding -> exit 1
+- a step whose state donation deleted zero bytes -> DLC411 -> exit 1
+- a baseline entry whose DLC41x finding no longer fires -> stale nag
+
+Exit 0 and one JSON report line on success.  docs/STATIC_ANALYSIS.md has
+the "reading a retrace report" runbook for when this stage goes red.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# The audit's question is dispatch-layer, not numerics: CPU answers it.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Honest compile counts need a cold persistent cache.
+os.environ.setdefault("DLCFN_COMPILE_CACHE", "off")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--steps", type=int, default=4, help="steady-state steps")
+    parser.add_argument("--warmup", type=int, default=2)
+    parser.add_argument("--k", type=int, default=2, help="multi-step span")
+    parser.add_argument(
+        "--baseline",
+        type=Path,
+        default=None,
+        help="suppression baseline (default scripts/lint_baseline.json)",
+    )
+    args = parser.parse_args(argv)
+
+    from deeplearning_cfn_tpu.analysis.compile_audit import run_compile_audit
+    from deeplearning_cfn_tpu.analysis.runner import (
+        DEFAULT_BASELINE,
+        apply_baseline,
+        load_baseline,
+    )
+    from deeplearning_cfn_tpu.analysis.sharding import AUDIT_RULE_IDS
+
+    report = run_compile_audit(
+        steady_steps=args.steps, warmup_steps=args.warmup, k=args.k
+    )
+
+    baseline_path = args.baseline if args.baseline is not None else DEFAULT_BASELINE
+    baseline = load_baseline(baseline_path) if baseline_path.exists() else set()
+    # This stage owns only the dynamic DLC41x namespace; lint owns the rest.
+    audit_baseline = {e for e in baseline if e[0] in AUDIT_RULE_IDS}
+    fresh, stale = apply_baseline(report.violations, audit_baseline)
+
+    for rule, rel, message in stale:
+        print(
+            f"compile-audit: stale baseline entry: {rule} {rel}: {message}",
+            file=sys.stderr,
+        )
+    for v in fresh:
+        print(f"compile-audit: {v.format()}", file=sys.stderr)
+
+    print(json.dumps(report.to_dict(), allow_nan=False))
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

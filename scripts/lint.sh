@@ -2,7 +2,8 @@
 # dlcfn-lint CI entry: the repo-native static-analysis pass
 # (docs/STATIC_ANALYSIS.md).  Lints the package, scripts/, and bench.py;
 # exit 1 on any finding, including broker-contract drift (DLC100/101).
-# Opt-in passes: --concurrency (DLC2xx), --protocol (DLC3xx), --baseline.
+# Opt-in passes: --concurrency (DLC2xx), --protocol (DLC3xx),
+# --sharding (DLC4xx JAX/SPMD trace safety), --baseline.
 # --json is shorthand for --format json (machine-readable findings).
 set -euo pipefail
 cd "$(dirname "$0")/.."

@@ -14,6 +14,13 @@ def test_repo_lints_clean():
     assert not violations, "\n" + render_text(violations)
 
 
+def test_repo_lints_clean_with_sharding_gate():
+    """Acceptance criterion of the DLC4xx pass: the compute tree carries
+    zero unsuppressed trace-safety findings."""
+    violations = run_lint(sharding=True)
+    assert not violations, "\n" + render_text(violations)
+
+
 def test_cli_lint_exits_zero(capsys):
     from deeplearning_cfn_tpu.cli import main
 

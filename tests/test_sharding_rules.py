@@ -1,0 +1,411 @@
+"""DLC4xx trace-safety fixtures: every rule fires on its seeded bug and
+stays silent on the repo's sanctioned idiom (docs/STATIC_ANALYSIS.md).
+
+The DLC4xx pass is *gated*: a plain ``lint_source`` (select=None) must
+never run it, so each case passes an explicit ``select`` — exactly how
+the runner enables the pass under ``dlcfn lint --sharding``.  Fixture
+paths live under ``train/`` because the pass scopes itself to the
+compute tree (train/, models/, ops/, bench.py).
+"""
+
+import textwrap
+
+from deeplearning_cfn_tpu.analysis import lint_source
+from deeplearning_cfn_tpu.analysis.sharding import (
+    AUDIT_RULE_IDS,
+    RULE_IDS,
+    canonical_mesh_axes,
+)
+
+COMPUTE_PATH = "deeplearning_cfn_tpu/train/x.py"
+
+
+def rules_for(src: str, select: set[str], path: str = COMPUTE_PATH):
+    return [v.rule for v in lint_source(path, textwrap.dedent(src), select=select)]
+
+
+# --- the gate itself --------------------------------------------------------
+
+
+def test_gated_rules_do_not_run_without_select():
+    """Growing the DLC4xx set must never change a plain `dlcfn lint`."""
+    src = """\
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * time.time()
+    """
+    fired = [v.rule for v in lint_source(COMPUTE_PATH, textwrap.dedent(src))]
+    assert not set(fired) & set(RULE_IDS)
+    assert rules_for(src, select={"DLC400"}) == ["DLC400"]
+
+
+def test_rules_scope_to_the_compute_tree():
+    """The same seeded bug outside train//models//ops//bench.py is out of
+    scope — cluster code does not dispatch jits."""
+    src = """\
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * time.time()
+    """
+    assert rules_for(src, {"DLC400"}, path="deeplearning_cfn_tpu/cluster/x.py") == []
+
+
+def test_noqa_suppresses_with_reason():
+    src = """\
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * time.time()  # dlcfn: noqa[DLC400] fixture wants the frozen timestamp
+    """
+    assert rules_for(src, {"DLC400"}) == []
+
+
+# --- DLC400: traced-code impurity -------------------------------------------
+
+
+def test_dlc400_fires_on_wall_clock_np_random_and_global():
+    src = """\
+        import time
+        import numpy as np
+        import jax
+
+        COUNTER = 0
+
+        @jax.jit
+        def step(x):
+            global COUNTER
+            noise = np.random.rand(*x.shape)
+            return x + noise + time.time()
+    """
+    assert rules_for(src, {"DLC400"}) == ["DLC400", "DLC400", "DLC400"]
+
+
+def test_dlc400_reaches_transform_bodies_and_bare_name_callees():
+    """lax.scan bodies and same-file functions they call run under the
+    same trace — the closure must reach them."""
+    src = """\
+        import time
+        import jax
+        from jax import lax
+
+        def helper(c):
+            return c * time.time()
+
+        def body(c, _):
+            return helper(c), None
+
+        def outer(c, xs):
+            return lax.scan(body, c, xs)
+    """
+    assert rules_for(src, {"DLC400"}) == ["DLC400"]
+
+
+def test_dlc400_silent_on_host_side_timing():
+    """The bench idiom: wall clock around the dispatch, never under it."""
+    src = """\
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def measure(x):
+            t0 = time.perf_counter()
+            step(x)
+            return time.perf_counter() - t0
+    """
+    assert rules_for(src, {"DLC400"}) == []
+
+
+# --- DLC401: train-state jit without donation -------------------------------
+
+
+def test_dlc401_fires_on_call_form_without_donation():
+    src = """\
+        import jax
+
+        def train_step(state, x, y):
+            return state
+
+        step = jax.jit(train_step)
+    """
+    assert rules_for(src, {"DLC401"}) == ["DLC401"]
+
+
+def test_dlc401_fires_on_state_annotation():
+    """A first param typed ``TrainState`` counts even under another name."""
+    src = """\
+        import jax
+
+        @jax.jit
+        def update(ts: TrainState, x):
+            return ts
+    """
+    assert rules_for(src, {"DLC401"}) == ["DLC401"]
+
+
+def test_dlc401_silent_on_donating_eval_and_dlc008_shapes():
+    """donate_argnums satisfies it; eval sites must NOT donate; the two
+    exact DLC008 shapes stay DLC008's findings, not doubled ones."""
+    src = """\
+        import jax
+
+        def train_step(state, x, y):
+            return state
+
+        def eval_step(state, x, y):
+            return state
+
+        step = jax.jit(train_step, donate_argnums=(0,))
+        ev = jax.jit(eval_step)
+        sharded = jax.jit(train_step, in_shardings=None, out_shardings=None)
+
+        @jax.jit
+        def decorated(state, x):
+            return state
+    """
+    assert rules_for(src, {"DLC401"}) == []
+
+
+# --- DLC402: retrace hazards ------------------------------------------------
+
+
+def test_dlc402_fires_on_bool_param_entering_jit():
+    src = """\
+        import jax
+
+        @jax.jit
+        def step(x, train: bool):
+            return x if train else -x
+    """
+    assert rules_for(src, {"DLC402"}) == ["DLC402"]
+
+
+def test_dlc402_fires_on_int_driving_python_control():
+    src = """\
+        import jax
+
+        def k_steps(x, k=4):
+            for _ in range(k):
+                x = x + 1
+            return x
+
+        fn = jax.jit(k_steps)
+    """
+    assert rules_for(src, {"DLC402"}) == ["DLC402"]
+
+
+def test_dlc402_fires_on_fstring_branch_under_trace():
+    src = """\
+        import jax
+
+        @jax.jit
+        def step(x):
+            if f"{x.shape}" == "(8,)":
+                return x
+            return -x
+    """
+    assert rules_for(src, {"DLC402"}) == ["DLC402"]
+
+
+def test_dlc402_silent_when_declared_static():
+    src = """\
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("train", "k"))
+        def step(x, train: bool, k: int = 4):
+            for _ in range(k):
+                x = x + 1
+            return x if train else -x
+    """
+    assert rules_for(src, {"DLC402"}) == []
+
+
+def test_dlc402_silent_on_int_only_used_as_data():
+    """An int that never drives `if`/`range` is ordinary traced data."""
+    src = """\
+        import jax
+
+        @jax.jit
+        def step(x, offset: int):
+            return x + offset
+    """
+    assert rules_for(src, {"DLC402"}) == []
+
+
+# --- DLC403: mesh-axis consistency ------------------------------------------
+
+
+def test_canonical_axes_machine_read_from_mesh_py():
+    axes = canonical_mesh_axes()
+    assert "dp" in axes and "tp" in axes and len(axes) >= 4
+
+
+def test_canonical_axes_extraction_from_custom_file(tmp_path):
+    alt = tmp_path / "mesh.py"
+    alt.write_text('AXIS_ORDER = ("rows", "cols")\n')
+    assert canonical_mesh_axes(str(alt)) == ("rows", "cols")
+
+
+def test_dlc403_fires_on_unknown_axis():
+    src = """\
+        from jax.sharding import PartitionSpec as P
+
+        SPEC = P(("dp", "fspd"))
+    """
+    fired = rules_for(src, {"DLC403"})
+    assert fired == ["DLC403"]
+
+
+def test_dlc403_fires_on_axis_name_kwarg():
+    src = """\
+        import jax
+
+        def f(x):
+            return jax.lax.psum(x, axis_name="data")
+    """
+    assert rules_for(src, {"DLC403"}) == ["DLC403"]
+
+
+def test_dlc403_silent_on_canonical_axes_and_none():
+    src = """\
+        from jax.sharding import PartitionSpec as P
+
+        BATCH = P(("dp", "fsdp"))
+        SEQ = P(("dp", "fsdp"), "sp")
+        REPLICATED = P(None)
+    """
+    assert rules_for(src, {"DLC403"}) == []
+
+
+# --- DLC404: host sync in the step loop -------------------------------------
+
+
+def test_dlc404_fires_on_unguarded_sync_in_step_loop():
+    src = """\
+        import jax
+
+        def loop(step, state, batches):
+            for x, y in batches:
+                state, metrics = step(state, x, y)
+                loss = float(metrics["loss"])
+            return state
+    """
+    assert rules_for(src, {"DLC404"}) == ["DLC404"]
+
+
+def test_dlc404_fires_on_item_and_block_until_ready():
+    src = """\
+        import jax
+
+        def loop(step, state, batches):
+            for x, y in batches:
+                state, metrics = step(state, x, y)
+                metrics["loss"].item()
+                jax.block_until_ready(state)
+            return state
+    """
+    assert rules_for(src, {"DLC404"}) == ["DLC404", "DLC404"]
+
+
+def test_dlc404_silent_behind_periodic_if():
+    """fit()'s sync_every idiom: readbacks behind a sync boundary."""
+    src = """\
+        import jax
+
+        def loop(step, state, batches):
+            for i, (x, y) in enumerate(batches):
+                state, metrics = step(state, x, y)
+                if i % 10 == 0:
+                    print(float(metrics["loss"]))
+            return state
+    """
+    assert rules_for(src, {"DLC404"}) == []
+
+
+def test_dlc404_silent_outside_step_loops():
+    """A loop that dispatches nothing step-like is any other host loop."""
+    src = """\
+        def summarize(values):
+            total = 0.0
+            for v in values:
+                total += float(v)
+            return total
+    """
+    assert rules_for(src, {"DLC404"}) == []
+
+
+# --- DLC405: nested jit / device_put under trace ----------------------------
+
+
+def test_dlc405_fires_on_nested_jit_and_device_put():
+    src = """\
+        import jax
+
+        @jax.jit
+        def step(x):
+            x = jax.device_put(x)
+
+            @jax.jit
+            def inner(y):
+                return y * 2
+
+            return inner(x)
+    """
+    assert sorted(rules_for(src, {"DLC405"})) == ["DLC405", "DLC405"]
+
+
+def test_dlc405_fires_on_jit_call_under_trace():
+    src = """\
+        import jax
+
+        @jax.jit
+        def step(x):
+            fn = jax.jit(lambda y: y * 2)
+            return fn(x)
+    """
+    assert rules_for(src, {"DLC405"}) == ["DLC405"]
+
+
+def test_dlc405_silent_on_host_side_placement():
+    """The bench idiom: device_put before dispatch, jit built at init."""
+    src = """\
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def run(x, sharding):
+            x = jax.device_put(x, sharding)
+            return step(x)
+    """
+    assert rules_for(src, {"DLC405"}) == []
+
+
+# --- baseline ratchet (shared with the dynamic DLC41x sentinel) --------------
+
+
+def test_stale_dlc4xx_baseline_entry_is_nagged():
+    """A baselined DLC4xx finding that no longer fires must surface as a
+    stale entry (the ratchet only ever shrinks) — for the static rules
+    and the compile-audit sentinel's DLC410/411 alike."""
+    from deeplearning_cfn_tpu.analysis.runner import apply_baseline
+
+    baseline = {
+        ("DLC403", "deeplearning_cfn_tpu/train/x.py", "long-gone axis typo"),
+        (AUDIT_RULE_IDS[0], "deeplearning_cfn_tpu/train/trainer.py", "old retrace"),
+    }
+    fresh, stale = apply_baseline([], baseline)
+    assert fresh == []
+    assert set(stale) == baseline
