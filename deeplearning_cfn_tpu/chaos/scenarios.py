@@ -881,12 +881,210 @@ def slice_loss_live(seed: int) -> ScenarioReport:
     return report
 
 
+# --- straggler ---------------------------------------------------------------
+
+
+def straggler(seed: int) -> ScenarioReport:
+    """One host runs injected-slow steps under seeded cross-host clock
+    skew; the merged trace must recover the skews from heartbeat pairs,
+    order events correctly, and name exactly the injected straggler."""
+    import json
+    import random
+
+    from deeplearning_cfn_tpu.obs.recorder import FlightRecorder
+    from deeplearning_cfn_tpu.obs.trace_export import (
+        chrome_trace,
+        merge_journals,
+        straggler_table,
+    )
+
+    report = ScenarioReport("straggler", seed)
+    rng = random.Random(seed)
+    hosts = ["host-a", "host-b", "host-c"]
+    slow_host = hosts[seed % len(hosts)]
+    # Skew magnitude > the 1 s step spacing: a raw-timestamp merge is
+    # GUARANTEED to interleave steps wrongly, so correct ordering after
+    # alignment is a real proof, not luck.  Virtual clocks throughout —
+    # every timestamp below is computed, never read from time.time().
+    base = 1_700_000_000.0
+    skews = {
+        host: round(rng.uniform(2.0, 6.0) * rng.choice((-1, 1)), 6)
+        for host in hosts
+    }
+    n_steps = 8
+    slow_steps = set(range(2, 7))  # 5 of 8: a strict slowest-count majority
+    slow_extra_ms = 40.0
+
+    root = Path(tempfile.mkdtemp(prefix="dlcfn-chaos-straggler-"))
+    try:
+        # Supervisor journal (skew 0 = the reference clock): observes
+        # each worker's beats 2 s after the true send instant.
+        sup = FlightRecorder(path=root / "sup.jsonl")
+        for host in hosts:
+            for seq, t_send in enumerate((0.0, 10.0, 20.0), start=1):
+                sup.record(
+                    "heartbeat_observed",
+                    ts=round(base + t_send + 2.0, 6),
+                    host="sup",
+                    pid=1,
+                    worker=host,
+                    seq=seq,
+                    age_s=2.0,
+                )
+        sup.close()
+        # Worker journals: every ts is the TRUE instant plus that host's
+        # clock skew (caller fields override the recorder's identity).
+        true_durations: dict[str, dict[int, float]] = {}
+        for hi, host in enumerate(hosts):
+            rec = FlightRecorder(path=root / f"{host}.jsonl")
+            for seq, t_send in enumerate((0.0, 10.0, 20.0), start=1):
+                rec.record(
+                    "heartbeat_sent",
+                    ts=round(base + t_send + skews[host], 6),
+                    host=host,
+                    pid=1,
+                    worker=host,
+                    seq=seq,
+                )
+            durations = {}
+            for step in range(n_steps):
+                dur_ms = 50.0 + hi * 1.0 + step * 0.5
+                if host == slow_host and step in slow_steps:
+                    dur_ms += slow_extra_ms
+                durations[step] = dur_ms
+                t_end = base + 100.0 + step * 1.0 + dur_ms / 1e3
+                rec.record(
+                    "step_time",
+                    ts=round(t_end + skews[host], 6),
+                    host=host,
+                    pid=1,
+                    worker=host,
+                    profiler="train",
+                    step=step,
+                    steps=1,
+                    total_ms=round(dur_ms, 3),
+                    dispatch_ms=round(dur_ms * 0.1, 3),
+                    host_ms=round(dur_ms * 0.05, 3),
+                )
+                rec.record(
+                    "span",
+                    ts=round(t_end + skews[host], 6),
+                    host=host,
+                    pid=1,
+                    worker=host,
+                    span="train_step",
+                    seconds=round(dur_ms / 1e3, 6),
+                    ok=True,
+                )
+            true_durations[host] = durations
+            rec.close()
+
+        paths = [root / "sup.jsonl"] + [root / f"{h}.jsonl" for h in hosts]
+
+        def step_sequence(events):
+            return [
+                e["step"] for e in events if e.get("kind") == "step_time"
+            ]
+
+        raw_events, _ = merge_journals(paths, align=False)
+        raw_seq = step_sequence(raw_events)
+        report.check(
+            raw_seq != sorted(raw_seq),
+            "raw (unaligned) merge interleaves steps out of order — the "
+            "injected skew is large enough to matter",
+        )
+
+        events, meta = merge_journals(paths, align=True)
+        report.check(meta["reference"] == "sup", "supervisor journal is the reference clock")
+        offsets = meta["offsets"]
+        report.check(
+            all(
+                abs(offsets.get(host, 0.0) + skews[host]) < 1e-3
+                for host in hosts
+            ),
+            "heartbeat pairs recover every host's clock offset (within 1 ms)",
+        )
+        aligned_seq = step_sequence(events)
+        report.check(
+            aligned_seq == sorted(aligned_seq),
+            "aligned merge orders every step_time event by true step across hosts",
+        )
+
+        table = straggler_table(events)
+        slowed_rows = [r for r in table["steps"] if r["step"] in slow_steps]
+        report.check(
+            bool(slowed_rows)
+            and all(
+                r["slowest"] == slow_host and r["margin_ms"] >= 30.0
+                for r in slowed_rows
+            ),
+            "every injected-slow step names the slow host with a wide margin",
+        )
+        report.check(
+            all(
+                r["margin_ms"] < 10.0
+                for r in table["steps"]
+                if r["step"] not in slow_steps
+            ),
+            "steps without injection show no false wide-margin straggler",
+        )
+        report.check(
+            table["top_straggler"] == slow_host,
+            "the slowest-count majority names the injected host",
+        )
+
+        trace = chrome_trace(events)
+        payload = json.dumps(trace, allow_nan=False)
+        decoded = json.loads(payload)
+        report.check(
+            decoded.get("traceEvents") == trace["traceEvents"],
+            "trace-event JSON is strict (allow_nan) and round-trips",
+        )
+        slices = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        report.check(
+            bool(slices)
+            and all(
+                isinstance(e.get("ts"), (int, float))
+                and isinstance(e.get("dur"), (int, float))
+                and e.get("dur") >= 0
+                and "pid" in e
+                and "tid" in e
+                for e in slices
+            ),
+            "every complete (X) slice carries ts/dur/pid/tid",
+        )
+        processes = {
+            e["args"]["name"]
+            for e in trace["traceEvents"]
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        }
+        report.check(
+            processes == set(hosts) | {"sup"},
+            "one trace process row per journal (3 workers + supervisor)",
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    report.details.update(
+        slow_host=slow_host,
+        slow_steps=sorted(slow_steps),
+        skews_s=dict(sorted(skews.items())),
+        recovered_offsets_s=dict(sorted(offsets.items())),
+        top_straggler=table["top_straggler"],
+        slowest_counts=table["slowest_counts"],
+        straggler_steps=len(table["steps"]),
+        trace_events=len(trace["traceEvents"]),
+    )
+    return report
+
+
 SCENARIOS: dict[str, Callable[[int], ScenarioReport]] = {
     "silent-death": silent_death,
     "partition": partition,
     "flaky-rpc": flaky_rpc,
     "slow-disk": slow_disk,
     "slice-loss-live": slice_loss_live,
+    "straggler": straggler,
 }
 
 

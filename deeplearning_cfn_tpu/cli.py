@@ -14,8 +14,12 @@ its runbooks (StackSetup.md).  Commands:
   dlcfn convert  --format cifar10 --src D --out O   dataset -> DLC1 records
   dlcfn status   [--metrics-dir M] [--cluster C | --broker H:P] [--journal J]
                  metrics, heartbeat-driven liveness, span aggregates
-                 (--format prom for Prometheus text exposition)
-  dlcfn events   [--journal J] [-n N] [--kind K]  tail the flight journal
+                 (--format prom for Prometheus text exposition;
+                 --profile adds step-profile + straggler tables)
+  dlcfn events   [--journal J] [-n N] [--kind K] [--follow]
+                 tail the flight journal (--follow = live, across rotation)
+  dlcfn trace    --journal J [--journal J2 ...] [--out trace.json]
+                 merge per-host journals into a Chrome/Perfetto timeline
 
 The local backend executes everything in-process (the fake cloud); the gcp
 backend renders the equivalent TPU API calls.  ``-P`` overrides template
@@ -574,13 +578,19 @@ def _status_liveness(args) -> dict | None:
 
 
 def _status_spans(args) -> dict | None:
-    """Span aggregates folded from a flight journal, or None."""
+    """Span aggregates folded from a flight journal, or None.
+
+    Beyond count/total/max, each span carries p50/p95/p99 over the
+    journal's most recent samples (the profiler's shared rolling-quantile
+    helper) — rendered as a summary family in the prom output."""
     if not args.journal:
         return None
+    from deeplearning_cfn_tpu.obs.profiler import RollingQuantiles
     from deeplearning_cfn_tpu.obs.recorder import read_journal
     from deeplearning_cfn_tpu.obs.tracing import SpanStats
 
     stats: dict[str, SpanStats] = {}
+    quantiles: dict[str, RollingQuantiles] = {}
     for event in read_journal(args.journal, kind="span"):
         name = event.get("span")
         seconds = event.get("seconds")
@@ -588,7 +598,60 @@ def _status_spans(args) -> dict | None:
             continue
         agg = stats.setdefault(name, SpanStats())
         agg.fold(float(seconds), bool(event.get("ok", True)))
-    return {name: agg.as_dict() for name, agg in sorted(stats.items())}
+        quantiles.setdefault(name, RollingQuantiles()).add(float(seconds))
+    out = {}
+    for name, agg in sorted(stats.items()):
+        row = agg.as_dict()
+        for key, value in quantiles[name].quantiles().items():
+            row[f"{key}_s"] = round(value, 6)
+        out[name] = row
+    return out
+
+
+def _status_profile(args) -> dict | None:
+    """Step-profile snapshots and straggler table from the journal, or
+    None (``--profile`` not passed / no journal / no profile events).
+
+    ``step_profile`` events carry a StepProfiler snapshot (the latest
+    per profiler name wins — it aggregates everything before it);
+    ``step_time`` events from two or more hosts feed the slowest-host-
+    per-step table (obs/trace_export.straggler_table)."""
+    if not getattr(args, "profile", False) or not args.journal:
+        return None
+    from deeplearning_cfn_tpu.obs.recorder import read_journal
+    from deeplearning_cfn_tpu.obs.trace_export import straggler_table
+
+    profilers: dict[str, dict] = {}
+    for event in read_journal(args.journal, kind="step_profile"):
+        name = event.get("name")
+        if isinstance(name, str):
+            profilers[name] = {
+                key: event[key]
+                for key in (
+                    "steps",
+                    "data_wait_ms",
+                    "h2d_ms",
+                    "dispatch_ms",
+                    "compute_ms",
+                    "host_ms",
+                    "step_ms",
+                    "phases",
+                )
+                if key in event
+            }
+    step_events = list(read_journal(args.journal, kind="step_time"))
+    hosts = {
+        e.get("worker") or e.get("host")
+        for e in step_events
+        if e.get("worker") or e.get("host")
+    }
+    stragglers = straggler_table(step_events) if len(hosts) >= 2 else None
+    out: dict = {}
+    if profilers:
+        out["profilers"] = dict(sorted(profilers.items()))
+    if stragglers and stragglers["steps"]:
+        out["stragglers"] = stragglers
+    return out or None
 
 
 def _status_pipeline(args) -> dict | None:
@@ -705,6 +768,7 @@ def cmd_status(args) -> int:
     pipeline = _status_pipeline(args)
     reshard = _status_reshard(args)
     mesh = _status_mesh(args)
+    profile = _status_profile(args)
     workers = _status_metrics(args.metrics_dir) if args.metrics_dir else None
     if args.metrics_dir and workers is None:
         print(f"no metrics under {args.metrics_dir}", file=sys.stderr)
@@ -720,11 +784,19 @@ def cmd_status(args) -> int:
                 pipeline=pipeline,
                 reshard=reshard,
                 mesh=mesh,
+                profile=profile,
             ),
             end="",
         )
         return 0
-    if liveness is None and spans is None and pipeline is None and mesh is None and reshard is None:
+    if (
+        liveness is None
+        and spans is None
+        and pipeline is None
+        and mesh is None
+        and reshard is None
+        and profile is None
+    ):
         # Metrics-only: the original (round-4) output shape, unchanged.
         print(json.dumps(workers, indent=2))
         return 0
@@ -739,6 +811,8 @@ def cmd_status(args) -> int:
         out["spans"] = spans
     if pipeline is not None:
         out["input_pipeline"] = pipeline
+    if profile is not None:
+        out["profile"] = profile
     if workers is not None:
         out["workers"] = workers
     print(json.dumps(out, indent=2))
@@ -747,8 +821,17 @@ def cmd_status(args) -> int:
 
 def cmd_events(args) -> int:
     """Tail the flight journal: the last N structured events, as JSONL
-    (machine form) — the operator's replay of what the cluster did."""
-    from deeplearning_cfn_tpu.obs.recorder import ENV_JOURNAL, read_journal
+    (machine form) — the operator's replay of what the cluster did.
+
+    ``--follow`` switches to live mode: print everything already
+    journaled (``-n`` is ignored), then poll for appends, surviving the
+    recorder's ``<path>.1`` rotation — ``tail -F`` for the journal.
+    Ctrl-C exits cleanly."""
+    from deeplearning_cfn_tpu.obs.recorder import (
+        ENV_JOURNAL,
+        follow_journal,
+        read_journal,
+    )
 
     path = args.journal or os.environ.get(ENV_JOURNAL)
     if not path:
@@ -756,6 +839,13 @@ def cmd_events(args) -> int:
             f"dlcfn events needs --journal (or ${ENV_JOURNAL}) pointing at "
             "a flight journal"
         )
+    if args.follow:
+        try:
+            for event in follow_journal(path, kind=args.kind, poll_s=args.poll):
+                print(json.dumps(event, allow_nan=False, default=str), flush=True)
+        except KeyboardInterrupt:
+            pass
+        return 0
     if not Path(path).exists() and not Path(path + ".1").exists():
         print(f"no journal at {path}", file=sys.stderr)
         return 1
@@ -765,6 +855,51 @@ def cmd_events(args) -> int:
         count += 1
     if count == 0:
         print("journal is empty (no matching events)", file=sys.stderr)
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Merge per-host flight journals into one Chrome/Perfetto timeline.
+
+    Clock alignment (on by default) recovers per-host offsets from the
+    heartbeat_sent / heartbeat_observed pairs both sides already journal
+    (obs/trace_export.py); the offsets and the straggler table go to
+    stderr, the trace JSON to ``--out`` (or stdout).  Load the JSON in
+    chrome://tracing or https://ui.perfetto.dev."""
+    from deeplearning_cfn_tpu.obs.trace_export import (
+        chrome_trace,
+        merge_journals,
+        straggler_table,
+    )
+
+    paths = [p for p in args.journal or []]
+    if not paths:
+        raise SystemExit(
+            "dlcfn trace needs --journal PATH (repeat once per host)"
+        )
+    missing = [
+        p for p in paths
+        if not Path(p).exists() and not Path(p + ".1").exists()
+    ]
+    if missing:
+        print(f"no journal at {', '.join(missing)}", file=sys.stderr)
+        return 1
+    events, meta = merge_journals(paths, align=not args.no_align)
+    trace = chrome_trace(events)
+    payload = json.dumps(trace, allow_nan=False, default=str)
+    if args.out:
+        Path(args.out).write_text(payload + "\n", encoding="utf-8")
+        print(
+            f"wrote {len(trace['traceEvents'])} trace events to {args.out}",
+            file=sys.stderr,
+        )
+    else:
+        print(payload)
+    summary: dict = {"clock": meta}
+    stragglers = straggler_table(events)
+    if stragglers["steps"]:
+        summary["stragglers"] = stragglers
+    print(json.dumps(summary, indent=2, default=str), file=sys.stderr)
     return 0
 
 
@@ -1155,6 +1290,11 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("--format", choices=["json", "prom"], default="json",
                     help="prom = Prometheus text exposition (liveness + "
                          "spans) for a textfile collector")
+    ps.add_argument("--profile", action="store_true",
+                    help="with --journal: step-profiler snapshots "
+                         "(per-phase p50/p95/p99) and, when step_time "
+                         "events span 2+ hosts, the slowest-host-per-step "
+                         "straggler table")
     ps.set_defaults(fn=cmd_status)
     # events tails the flight recorder's journal.
     pe = sub.add_parser("events", help="tail the obs flight journal")
@@ -1165,14 +1305,35 @@ def main(argv: list[str] | None = None) -> int:
     pe.add_argument("--kind", default=None,
                     help="only events of this kind (e.g. span, lifecycle, "
                          "liveness)")
+    pe.add_argument("--follow", action="store_true",
+                    help="live mode: print existing events then poll for "
+                         "appends, across journal rotation (tail -F)")
+    pe.add_argument("--poll", type=float, default=0.5, metavar="S",
+                    help="--follow poll interval in seconds")
     pe.set_defaults(fn=cmd_events)
+    # trace merges per-host journals into a Chrome/Perfetto timeline.
+    pt = sub.add_parser(
+        "trace",
+        help="merge flight journals into a Chrome/Perfetto trace timeline",
+    )
+    pt.add_argument("--journal", action="append", default=[], metavar="PATH",
+                    help="flight journal to merge (repeat once per host)")
+    pt.add_argument("--out", default=None,
+                    help="write trace JSON here (default: stdout; the "
+                         "clock-offset/straggler summary always goes to "
+                         "stderr)")
+    pt.add_argument("--no-align", action="store_true", dest="no_align",
+                    help="skip heartbeat-based cross-host clock alignment "
+                         "(merge on raw per-host timestamps)")
+    pt.set_defaults(fn=cmd_trace)
     # chaos runs named fault-injection scenarios against real components.
     px = sub.add_parser(
         "chaos", help="run seeded fault-injection scenarios (resilience soak)"
     )
     px.add_argument("--scenario", default=None,
                     help="scenario name (see --list): silent-death, "
-                         "partition, flaky-rpc, slow-disk, slice-loss-live")
+                         "partition, flaky-rpc, slow-disk, slice-loss-live, "
+                         "straggler")
     px.add_argument("--seed", type=int, default=0,
                     help="fault-schedule seed; reports are deterministic "
                          "per (scenario, seed)")

@@ -494,6 +494,7 @@ class BrokerLivenessWatcher:
         self.bus = bus
         self._root = root
         self._fetch = fetch  # injectable: () -> {worker: (age_s, count)}
+        self._last_counts: dict[str, int] = {}
         self.table = LivenessTable(
             config=config or LivenessConfig(),
             clock=clock,
@@ -541,6 +542,18 @@ class BrokerLivenessWatcher:
         """One fetch + sweep; returns the liveness transitions."""
         for worker, (age_s, count) in self._dump_heartbeats().items():
             self.table.observe(worker, age_s=age_s, count=count)
+            # Journal each NEW beat with the observer's clock: paired
+            # with the worker's heartbeat_sent event of the same seq,
+            # obs/trace_export.py derives sender->observer clock offsets
+            # (observed ts - age_s names the send instant on THIS clock).
+            if count != self._last_counts.get(worker):
+                self._last_counts[worker] = count
+                get_recorder().record(
+                    "heartbeat_observed",
+                    worker=worker,
+                    seq=count,
+                    age_s=round(float(age_s), 6),
+                )
         return self.table.sweep()
 
     def snapshot(self) -> dict:

@@ -1,17 +1,18 @@
-"""Cluster observability plane: flight recorder, spans, liveness.
+"""Cluster observability plane: flight recorder, spans, liveness, profiler.
 
 The control plane (broker, elasticity, recovery, provisioner) and the
 data plane (trainer) both feed one bounded JSONL flight journal; the
-``dlcfn status`` / ``dlcfn events`` commands and the Prometheus
-exporter read it back out.  Nothing in here imports jax at module
-scope — the broker and CLI processes must stay light; the one jax
-dependency (``train.metrics.json_safe``) is imported lazily at first
-record.
+``dlcfn status`` / ``dlcfn events`` / ``dlcfn trace`` commands and the
+Prometheus exporter read it back out.  Nothing in here imports jax at
+module scope — the broker and CLI processes must stay light; the one
+jax dependency (``train.metrics.json_safe``) is imported lazily at
+first record.
 """
 
 from deeplearning_cfn_tpu.obs.recorder import (
     FlightRecorder,
     configure,
+    follow_journal,
     get_recorder,
     read_journal,
 )
@@ -22,10 +23,23 @@ from deeplearning_cfn_tpu.obs.liveness import (
     WorkerState,
 )
 from deeplearning_cfn_tpu.obs.heartbeat import Heartbeater
+from deeplearning_cfn_tpu.obs.profiler import (
+    NULL_PROFILER,
+    RollingQuantiles,
+    StepProfiler,
+    program_attribution,
+    program_cost,
+)
+from deeplearning_cfn_tpu.obs.trace_export import (
+    chrome_trace,
+    merge_journals,
+    straggler_table,
+)
 
 __all__ = [
     "FlightRecorder",
     "configure",
+    "follow_journal",
     "get_recorder",
     "read_journal",
     "span",
@@ -35,4 +49,12 @@ __all__ = [
     "LivenessTable",
     "WorkerState",
     "Heartbeater",
+    "NULL_PROFILER",
+    "RollingQuantiles",
+    "StepProfiler",
+    "program_attribution",
+    "program_cost",
+    "chrome_trace",
+    "merge_journals",
+    "straggler_table",
 ]

@@ -63,15 +63,21 @@ def render_prometheus(
     pipeline: Mapping[str, Mapping[str, Any]] | None = None,
     reshard: Mapping[str, Any] | None = None,
     mesh: Mapping[str, Any] | None = None,
+    profile: Mapping[str, Any] | None = None,
 ) -> str:
     """Render liveness snapshot + span aggregates + input-pipeline
     counters as Prometheus text.
 
     ``liveness`` is ``LivenessTable.snapshot()``; ``spans`` is
-    ``tracing.span_aggregates()``; ``pipeline`` is
+    ``tracing.span_aggregates()`` (span dicts carrying ``p50_s`` /
+    ``p95_s`` / ``p99_s`` additionally render as a summary-style
+    ``dlcfn_span_seconds`` family); ``pipeline`` is
     ``train.pipeline.fold_pipeline_events()``; ``reshard`` is
     ``fold_reshard_events()``; ``mesh`` is the current mesh/contract
-    shape from ``dlcfn status --cluster``.  Any may be None/empty.
+    shape from ``dlcfn status --cluster``; ``profile`` is the
+    ``dlcfn status --profile`` dict (``{"profilers": {name: snapshot}}``)
+    whose per-phase quantiles render as ``dlcfn_step_phase_ms``
+    summaries.  Any may be None/empty.
     """
     lines: list[str] = []
     if liveness:
@@ -119,6 +125,33 @@ def render_prometheus(
         ]
         for name, agg in spans.items():
             lines.append(f"dlcfn_span_seconds_max{_labels(span=name)} {agg['max_s']}")
+        quantiled = {
+            name: agg for name, agg in spans.items() if "p50_s" in agg
+        }
+        if quantiled:
+            lines += [
+                "# HELP dlcfn_span_seconds Span duration quantiles over the journal window.",
+                "# TYPE dlcfn_span_seconds summary",
+            ]
+            for name, agg in quantiled.items():
+                for quantile, key in (
+                    ("0.5", "p50_s"),
+                    ("0.95", "p95_s"),
+                    ("0.99", "p99_s"),
+                ):
+                    value = agg.get(key)
+                    if value is None:
+                        continue
+                    lines.append(
+                        f"dlcfn_span_seconds"
+                        f"{_labels(span=name, quantile=quantile)} {value}"
+                    )
+                lines.append(
+                    f"dlcfn_span_seconds_sum{_labels(span=name)} {agg['total_s']}"
+                )
+                lines.append(
+                    f"dlcfn_span_seconds_count{_labels(span=name)} {agg['count']}"
+                )
     if pipeline:
         gauges = (
             ("bytes_transferred", "Host->device bytes moved by the input pipeline."),
@@ -174,4 +207,55 @@ def render_prometheus(
                 f"# TYPE dlcfn_mesh_{key} gauge",
             ]
             lines.append(f"dlcfn_mesh_{key}{_labels(cluster=cluster)} {value}")
+    profilers = (profile or {}).get("profilers") or {}
+    if profilers:
+        lines += [
+            "# HELP dlcfn_step_phase_ms Step-phase duration quantiles (rolling window).",
+            "# TYPE dlcfn_step_phase_ms summary",
+        ]
+        for prof_name, snap in profilers.items():
+            for phase, stats in (snap.get("phases") or {}).items():
+                for quantile, key in (
+                    ("0.5", "p50_ms"),
+                    ("0.95", "p95_ms"),
+                    ("0.99", "p99_ms"),
+                ):
+                    value = stats.get(key)
+                    if value is None:
+                        continue
+                    lines.append(
+                        f"dlcfn_step_phase_ms"
+                        f"{_labels(cluster=cluster, profiler=prof_name, phase=phase, quantile=quantile)}"
+                        f" {value}"
+                    )
+                lines.append(
+                    f"dlcfn_step_phase_ms_sum"
+                    f"{_labels(cluster=cluster, profiler=prof_name, phase=phase)}"
+                    f" {stats.get('total_ms', 0.0)}"
+                )
+                lines.append(
+                    f"dlcfn_step_phase_ms_count"
+                    f"{_labels(cluster=cluster, profiler=prof_name, phase=phase)}"
+                    f" {stats.get('count', 0)}"
+                )
+        lines += [
+            "# HELP dlcfn_step_ms Whole-step duration quantiles (rolling window).",
+            "# TYPE dlcfn_step_ms summary",
+        ]
+        for prof_name, snap in profilers.items():
+            step_ms = snap.get("step_ms") or {}
+            for quantile, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+                value = step_ms.get(key)
+                if value is None:
+                    continue
+                lines.append(
+                    f"dlcfn_step_ms"
+                    f"{_labels(cluster=cluster, profiler=prof_name, quantile=quantile)}"
+                    f" {value}"
+                )
+            lines.append(
+                f"dlcfn_step_ms_count"
+                f"{_labels(cluster=cluster, profiler=prof_name)}"
+                f" {snap.get('steps', 0)}"
+            )
     return "\n".join(lines) + ("\n" if lines else "")

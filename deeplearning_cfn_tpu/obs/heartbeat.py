@@ -92,6 +92,12 @@ class Heartbeater(threading.Thread):
         self._conn.heartbeat(self.worker_id)
         with self._lock:
             self.beats_sent += 1
+            seq = self.beats_sent
+        # Journaled with the SENDER's clock, outside the lock: matched
+        # against the supervisor's heartbeat_observed event (same worker,
+        # same seq) by obs/trace_export.py to recover cross-host clock
+        # offsets for the merged timeline.
+        get_recorder().record("heartbeat_sent", worker=self.worker_id, seq=seq)
 
     def beat_step(self) -> bool:
         """One protected beat iteration (the body of the daemon loop).

@@ -405,6 +405,7 @@ class DevicePrefetcher:
         size: int = 2,
         workers: int = 1,
         stats=None,
+        profiler=None,
     ):
         import threading
 
@@ -412,6 +413,11 @@ class DevicePrefetcher:
         self._sharding = sharding
         self._size = max(1, size)
         self._stats = stats
+        # Optional obs.profiler.StepProfiler: producer-side device_put
+        # time folds into its "h2d" phase with critical=False — the
+        # transfer overlaps compute, so it informs the phase stats but
+        # is not subtracted from the consumer's host residual.
+        self._profiler = profiler
         self._stop = threading.Event()
         # _src_lock serializes source pulls (sequence assignment); _cond
         # guards the reorder buffer and the consumer cursor.
@@ -461,7 +467,12 @@ class DevicePrefetcher:
                     from deeplearning_cfn_tpu.train.pipeline import nbytes_of
 
                     self._stats.add_transfer(nbytes_of((item.x, item.y)))
+                t_put = time.perf_counter()
                 item = Batch(*device_put_batch(item, self._sharding))
+                if self._profiler is not None:
+                    self._profiler.fold(
+                        "h2d", time.perf_counter() - t_put, critical=False
+                    )
             t0 = time.perf_counter()
             with self._cond:
                 # Bound the buffer to ``size`` batches ahead of the

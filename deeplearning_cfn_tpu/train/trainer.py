@@ -741,6 +741,7 @@ class Trainer:
         prefetch: int = 2,
         prefetch_workers: int = 1,
         reshard: Any = None,
+        profiler: Any = None,
     ) -> tuple[TrainState, list[float]]:
         """``stop_fn(metrics) -> True`` ends training early — the
         time-to-accuracy mode (the reference's only published CIFAR metric
@@ -782,9 +783,20 @@ class Trainer:
         breaks out, returning the partial losses like an early stop_fn
         exit.  With a prefetcher, already-placed batches are simply
         re-put onto the new mesh by device_put_tree.
+
+        ``profiler`` (an obs.profiler.StepProfiler, default None = off)
+        splits each step into data_wait / h2d / dispatch / compute /
+        host phases; device compute is only observed at the loop's
+        existing sync boundaries (amortized over the steps drained
+        there), so nothing about the dispatch pipeline changes when
+        profiling is on.  NOTE: the first step's interval includes
+        compile — read p50, not max, for steady-state.
         """
+        from deeplearning_cfn_tpu.obs.profiler import NULL_PROFILER
         from deeplearning_cfn_tpu.train.data import DevicePrefetcher
         from deeplearning_cfn_tpu.train.pipeline import PipelineStats
+
+        prof = profiler if profiler is not None else NULL_PROFILER
 
         losses: list[float] = []
         pending: list[jax.Array] = []  # device scalars awaiting readback
@@ -803,11 +815,17 @@ class Trainer:
                 prefetch,
                 workers=prefetch_workers,
                 stats=stats,
+                profiler=profiler,
             )
+        # data_wait = host blocked pulling the next batch (after the
+        # prefetcher, so a full buffer reads as ~zero wait).  On the
+        # disabled path wrap_source returns `batches` unchanged.
+        batches = prof.wrap_source(batches)
         # Global step tracked host-side (syncing state.step every iteration
         # would stall the dispatch pipeline); resume-aware so checkpoints
         # after a restore are labeled with the true training step.
         gstep = int(jax.device_get(state.step))
+        prof.start()
         try:
             for i, batch in enumerate(batches):
                 if reshard is not None and reshard.pending():
@@ -830,17 +848,20 @@ class Trainer:
                 # device execution (docs/OBSERVABILITY.md) — a sudden jump
                 # here means the dispatch queue filled and the host blocked.
                 with span("train_step"):
-                    x = device_put_tree(batch.x, self.batch_sharding)
-                    y = device_put_tree(batch.y, self.batch_sharding)
-                    with set_mesh(self.mesh):
-                        state, metrics = step_fn(state, x, y)
+                    with prof.phase("h2d"):
+                        x = device_put_tree(batch.x, self.batch_sharding)
+                        y = device_put_tree(batch.y, self.batch_sharding)
+                    with prof.phase("dispatch"):
+                        with set_mesh(self.mesh):
+                            state, metrics = step_fn(state, x, y)
                 gstep += 1
                 pending.append(metrics["loss"])
                 if i == 0:
                     # Time-to-first-step (includes compile) — one half of the
                     # driver's template-to-first-step wallclock metric; the
                     # block is one-time and doubles as compile completion.
-                    jax.block_until_ready(metrics["loss"])
+                    with prof.sync_boundary():
+                        jax.block_until_ready(metrics["loss"])
                     self.first_step_seconds = time.perf_counter() - t_fit
                     self.first_step_at = time.perf_counter()
                 if logger:
@@ -854,10 +875,15 @@ class Trainer:
                 if gstep % sync_every == 0 or i == steps - 1:
                     # The host blocks here anyway, so drain the pending device
                     # scalars — O(log_every) live buffers instead of O(steps).
-                    losses.extend(float(v) for v in jax.device_get(pending))
+                    # For the profiler this is the sync boundary where device
+                    # time surfaces: the blocked seconds are a lower bound on
+                    # compute, amortized over the steps drained.
+                    with prof.sync_boundary(len(pending)):
+                        losses.extend(float(v) for v in jax.device_get(pending))
                     pending.clear()
                     if stop_fn is not None and stop_fn(metrics):
                         break
+                prof.step_done(step=gstep)
         finally:
             # Exceptions mid-loop must not leak a live producer thread.
             if prefetcher is not None:

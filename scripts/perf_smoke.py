@@ -1,12 +1,16 @@
-"""Perf-smoke gate: assert the compact-dtype input path is actually taken.
+"""Perf-smoke gate: compact-dtype input path + profiler overhead/sentinel.
 
 Runs a tiny CPU pipeline microbench — the same uint8 synthetic stream a
 real bench uses, through ``DevicePrefetcher(workers=2)`` with counters —
 against a float32 baseline of identical shape, and asserts structural
-properties only (byte counts, batch counts, dtype preservation).  No
-wall-clock assertions: CI machines are noisy and this gate must never
-flake on a slow runner; docs/PERFORMANCE.md covers how to read the
-timing counters it prints.
+properties (byte counts, batch counts, dtype preservation).  Wall-clock
+is asserted only as RATIOS with wide margins (never absolute CI-machine
+speed): the StepProfiler overhead guard compares an instrumented loop
+against a bare one around a step big enough (~ms) that the <2% budget
+is ~30x the profiler's actual per-step cost, median-of-3 to shrug off
+scheduler noise; the step-time regression sentinel asserts ordering
+(p99 >= p50) and a deliberately loose absolute ceiling.
+docs/PERFORMANCE.md covers how to read the timing counters it prints.
 
 Exit 0 and one JSON line on success; exit 1 with a message on violation.
 """
@@ -58,6 +62,66 @@ def run_pipeline(dtype: str) -> tuple[dict, object]:
     return stats.snapshot(), last_x
 
 
+PROFILE_STEPS = 30
+PROFILE_REPEATS = 3
+OVERHEAD_BUDGET = 0.02  # enabling the profiler may cost <2% of step time
+
+
+def profiler_overhead() -> dict:
+    """Measure StepProfiler cost against a bare loop over a jitted step.
+
+    The step (1024x1024 matmul) runs ~1 ms on CPU, so the 2% budget is
+    tens of microseconds against the profiler's ~1-2 us of bookkeeping —
+    a wide structural margin, not a tight wall-clock bet.  Median of
+    three interleaved repeats absorbs scheduler noise.  Also returns the
+    profiler's snapshot for the step-time regression sentinel.
+    """
+    import time
+
+    from deeplearning_cfn_tpu.obs.profiler import StepProfiler
+
+    @jax.jit
+    def step(a):
+        return a @ a
+
+    a = jnp.ones((1024, 1024), jnp.float32)
+    step(a).block_until_ready()  # compile outside every timed window
+
+    def bare_loop() -> float:
+        t0 = time.perf_counter()
+        out = a
+        for _ in range(PROFILE_STEPS):
+            out = step(out)
+        out.block_until_ready()
+        return time.perf_counter() - t0
+
+    def profiled_loop(prof: StepProfiler) -> float:
+        t0 = time.perf_counter()
+        out = a
+        prof.start()
+        for i in range(PROFILE_STEPS):
+            with prof.phase("dispatch"):
+                out = step(out)
+            prof.step_done(step=i)
+        with prof.sync_boundary(PROFILE_STEPS):
+            out.block_until_ready()
+        return time.perf_counter() - t0
+
+    bare, profiled = [], []
+    prof = StepProfiler(name="perf_smoke")
+    for _ in range(PROFILE_REPEATS):
+        bare.append(bare_loop())
+        profiled.append(profiled_loop(prof))
+    bare_s = sorted(bare)[len(bare) // 2]
+    profiled_s = sorted(profiled)[len(profiled) // 2]
+    return {
+        "bare_s": round(bare_s, 6),
+        "profiled_s": round(profiled_s, 6),
+        "overhead_fraction": round(profiled_s / bare_s - 1.0, 6),
+        "snapshot": prof.snapshot(),
+    }
+
+
 def main() -> int:
     u8_snap, u8_x = run_pipeline("uint8")
     f32_snap, f32_x = run_pipeline("float32")
@@ -101,6 +165,41 @@ def main() -> int:
     if not np.isfinite(dq).all() or abs(float(dq.mean())) > 1.0:
         failures.append(f"dequantized stream off-distribution (mean {dq.mean():.3f})")
 
+    # Profiling must be OFF by default outside bench/status paths: fit's
+    # default is None (-> NULL_PROFILER), and a disabled profiler's
+    # wrap_source is the identity (zero iterator indirection).
+    import inspect
+
+    from deeplearning_cfn_tpu.obs.profiler import NULL_PROFILER
+    from deeplearning_cfn_tpu.train.trainer import Trainer
+
+    if inspect.signature(Trainer.fit).parameters["profiler"].default is not None:
+        failures.append("Trainer.fit profiles by default (profiler default != None)")
+    probe = iter(())
+    if NULL_PROFILER.wrap_source(probe) is not probe:
+        failures.append("disabled profiler wraps the batch source (overhead when off)")
+
+    # Overhead guard: enabling the profiler may cost <2% of step time.
+    overhead = profiler_overhead()
+    if overhead["overhead_fraction"] >= OVERHEAD_BUDGET:
+        failures.append(
+            f"StepProfiler overhead {overhead['overhead_fraction']:.2%} "
+            f">= {OVERHEAD_BUDGET:.0%} budget "
+            f"(bare {overhead['bare_s']}s vs profiled {overhead['profiled_s']}s)"
+        )
+    # Step-time regression sentinel: distribution shape, not raw speed —
+    # quantile ordering must hold and p99 of a ~1 ms matmul step must
+    # stay under a deliberately loose ceiling even on a slow runner.
+    snap = overhead["snapshot"]
+    p50, p99 = snap["step_ms"].get("p50"), snap["step_ms"].get("p99")
+    if p50 is None or p99 is None or not (0 < p50 <= p99):
+        failures.append(f"step-time quantiles malformed: p50={p50} p99={p99}")
+    elif p99 > 2000.0:
+        failures.append(f"step-time p99 {p99}ms blew the 2000ms sentinel bound")
+    for phase in ("dispatch", "compute", "host"):
+        if phase not in snap["phases"]:
+            failures.append(f"profiler snapshot missing phase {phase!r}")
+
     if failures:
         for f in failures:
             print(f"perf-smoke: {f}", file=sys.stderr)
@@ -114,6 +213,11 @@ def main() -> int:
                     u8_snap["bytes_transferred"] / f32_snap["bytes_transferred"], 4
                 ),
                 "workers": WORKERS,
+                "profiler_overhead": {
+                    k: overhead[k]
+                    for k in ("bare_s", "profiled_s", "overhead_fraction")
+                },
+                "step_ms": snap["step_ms"],
             },
             allow_nan=False,
         )

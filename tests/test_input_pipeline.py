@@ -303,6 +303,45 @@ def test_fit_worker_count_does_not_change_losses():
     np.testing.assert_allclose(results[1], results[4], rtol=1e-6)
 
 
+def test_pipeline_stats_fresh_per_fit_and_counters_pinned():
+    # Regression: each fit() must bind a FRESH PipelineStats — a second
+    # fit on the same trainer reporting accumulated counters (12 batches
+    # after 6+6) would wreck the journal fold's per-run averages.  Pin
+    # the exact totals for both a serial and a parallel producer pool.
+    from deeplearning_cfn_tpu.models.lenet import LeNet
+    from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
+    from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
+
+    ds = SyntheticDataset(
+        shape=(28, 28, 1), num_classes=10, batch_size=32, dtype="uint8"
+    )
+    mesh = build_mesh(MeshSpec(dp=8))
+    trainer = Trainer(
+        LeNet(),
+        mesh,
+        TrainerConfig(strategy="dp", learning_rate=0.05, input_stats=ds.input_stats),
+    )
+    sample = next(iter(ds.batches(1)))
+    state = trainer.init(jax.random.key(0), jnp.asarray(sample.x))
+    per_run_bytes = 6 * nbytes_of((sample.x, sample.y))
+    snaps = []
+    stats_objects = []
+    for workers in (1, 2):
+        state, _ = trainer.fit(state, ds.batches(6), steps=6, prefetch_workers=workers)
+        stats_objects.append(trainer.last_pipeline_stats)
+        snap = trainer.last_pipeline_stats.snapshot()
+        snaps.append(snap)
+        assert snap["batches"] == 6, f"workers={workers}: {snap['batches']}"
+        assert snap["bytes_transferred"] == per_run_bytes
+    assert stats_objects[0] is not stats_objects[1]
+    # The journal fold sees the two fits as two runs of the same pipeline.
+    folded = fold_pipeline_events([dict(s) for s in snaps])
+    (agg,) = folded.values()
+    assert agg["runs"] == 2
+    assert agg["batches"] == 12
+    assert agg["bytes_transferred"] == 2 * per_run_bytes
+
+
 def test_device_put_tree_skips_placed_leaves():
     sharding = _sharding()
     placed = jax.device_put(jnp.ones((4, 4)), sharding)

@@ -1,0 +1,366 @@
+"""Cross-host trace timeline: clock alignment golden test, Chrome trace
+shape, straggler attribution, journal following, and the CLI surface
+(``dlcfn trace``, ``dlcfn events --follow``, ``dlcfn status --profile``).
+
+The golden fixture plants a KNOWN clock skew per host (+3 s / -2 s) plus
+the heartbeat_sent/heartbeat_observed pairs the broker path journals,
+then asserts the recovered offsets, the merged event ordering, and that
+the straggler table blames the right host.  No wall-clock anywhere —
+fixture timestamps are synthetic and ``follow_journal`` runs on an
+injected sleep/stop."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from deeplearning_cfn_tpu.cli import main
+from deeplearning_cfn_tpu.obs.recorder import FlightRecorder, follow_journal
+from deeplearning_cfn_tpu.obs.trace_export import (
+    chrome_trace,
+    heartbeat_offsets,
+    merge_journals,
+    straggler_table,
+)
+
+#: Planted skew of each worker clock relative to the supervisor ("sup").
+SKEWS = {"host-a": 3.0, "host-b": -2.0}
+BASE = 1000.0
+
+
+def _write_fixture(tmp_path):
+    """Three journals — supervisor + two skewed workers — on one true
+    timeline.  host-b is 10 ms slower than host-a on every step."""
+    paths = {
+        name: tmp_path / f"{name}.jsonl" for name in ("sup", *SKEWS)
+    }
+    sup = FlightRecorder(path=paths["sup"])
+    workers = {name: FlightRecorder(path=paths[name]) for name in SKEWS}
+    for worker, skew in sorted(SKEWS.items()):
+        for seq in (1, 2, 3):
+            true_send = BASE + 10.0 * seq
+            workers[worker].record(
+                "heartbeat_sent", worker=worker, seq=seq, ts=true_send + skew
+            )
+            # Observed 1 s later on the supervisor clock (no sup skew).
+            sup.record(
+                "heartbeat_observed",
+                worker=worker,
+                seq=seq,
+                age_s=1.0,
+                ts=true_send + 1.0,
+                host="sup",
+            )
+    for step in range(5):
+        for worker, skew in sorted(SKEWS.items()):
+            total_ms = (60.0 if worker == "host-b" else 50.0) + step
+            true_end = BASE + 100.0 + step + (0.2 if worker == "host-b" else 0.0)
+            workers[worker].record(
+                "step_time",
+                worker=worker,
+                step=step,
+                total_ms=total_ms,
+                dispatch_ms=total_ms - 5.0,
+                host_ms=5.0,
+                ts=true_end + skew,
+            )
+    workers["host-a"].record(
+        "span",
+        worker="host-a",
+        span="train_step",
+        seconds=0.05,
+        step=1,
+        ok=True,
+        ts=BASE + 101.0 + SKEWS["host-a"],
+    )
+    for rec in (sup, *workers.values()):
+        rec.close()
+    return [str(paths[name]) for name in ("sup", "host-a", "host-b")]
+
+
+def test_heartbeat_offsets_recover_planted_skew(tmp_path):
+    paths = _write_fixture(tmp_path)
+    _, meta = merge_journals(paths)
+    assert meta["reference"] == "sup"
+    assert meta["aligned"] is True
+    # Recovered offset is minus the planted skew (maps worker ts back
+    # onto the supervisor clock).
+    for worker, skew in SKEWS.items():
+        assert meta["offsets"][worker] == pytest.approx(-skew, abs=1e-6)
+    assert meta["offsets"]["sup"] == 0.0
+
+
+def test_alignment_restores_cross_host_step_order(tmp_path):
+    paths = _write_fixture(tmp_path)
+    raw, raw_meta = merge_journals(paths, align=False)
+    aligned, _ = merge_journals(paths, align=True)
+    raw_steps = [e["step"] for e in raw if e.get("kind") == "step_time"]
+    aligned_steps = [e["step"] for e in aligned if e.get("kind") == "step_time"]
+    # With ±seconds of skew against ~1 s steps, the raw merge interleaves
+    # whole step ranges out of order; alignment makes the sequence
+    # monotone (both hosts' step N before anyone's step N+1).
+    assert raw_steps != sorted(raw_steps)
+    assert aligned_steps == sorted(aligned_steps)
+    assert raw_meta["aligned"] is False and raw_meta["offsets"]["host-a"] == 0.0
+
+
+def test_journals_without_heartbeats_fall_back_to_raw(tmp_path):
+    rec = FlightRecorder(path=tmp_path / "solo.jsonl")
+    rec.record("step_time", worker="solo", step=0, total_ms=10.0, ts=1.0)
+    rec.close()
+    events, meta = merge_journals([tmp_path / "solo.jsonl"])
+    assert meta["reference"] is None and meta["aligned"] is False
+    assert [e["ts"] for e in events] == [1.0]
+    # Direct helper: every journal gets an offset entry even unmatched.
+    offsets, reference = heartbeat_offsets({"solo": []})
+    assert offsets == {"solo": 0.0} and reference is None
+
+
+def test_straggler_table_blames_the_slow_host(tmp_path):
+    paths = _write_fixture(tmp_path)
+    events, _ = merge_journals(paths)
+    table = straggler_table(events)
+    assert table["top_straggler"] == "host-b"
+    assert table["slowest_counts"] == {"host-b": 5}
+    assert [row["step"] for row in table["steps"]] == [0, 1, 2, 3, 4]
+    row0 = table["steps"][0]
+    assert row0["slowest"] == "host-b"
+    assert row0["slowest_ms"] == pytest.approx(60.0)
+    assert row0["margin_ms"] == pytest.approx(5.0)  # 60 - median(50, 60)
+    assert set(row0["hosts"]) == {"host-a", "host-b"}
+
+
+def test_straggler_table_skips_single_host_steps():
+    events = [
+        {"kind": "step_time", "worker": "a", "step": 0, "total_ms": 10.0},
+        {"kind": "step_time", "worker": "a", "step": 1, "total_ms": 10.0},
+        {"kind": "step_time", "worker": "b", "step": 1, "total_ms": 30.0},
+    ]
+    table = straggler_table(events)
+    assert [row["step"] for row in table["steps"]] == [1]
+    assert table["top_straggler"] == "b"
+
+
+def test_chrome_trace_structure(tmp_path):
+    paths = _write_fixture(tmp_path)
+    events, _ = merge_journals(paths)
+    trace = chrome_trace(events)
+    # Strict JSON, loadable by chrome://tracing / Perfetto.
+    trace = json.loads(json.dumps(trace, allow_nan=False))
+    assert trace["displayTimeUnit"] == "ms"
+    rows = trace["traceEvents"]
+    meta_rows = [r for r in rows if r["ph"] == "M"]
+    assert {r["args"]["name"] for r in meta_rows} == {"sup", "host-a", "host-b"}
+    pids = {r["args"]["name"]: r["pid"] for r in meta_rows}
+    slices = [r for r in rows if r["ph"] == "X"]
+    assert len(slices) == 11  # 10 step_time + 1 span
+    for r in slices:
+        assert r["dur"] > 0 and r["ts"] >= 0
+        assert r["pid"] in pids.values()
+    steps = [r for r in slices if r["cat"] == "step"]
+    assert all(r["tid"] == 1 for r in steps)
+    assert steps[0]["name"] == "step 0"
+    assert "dispatch_ms" in steps[0]["args"] and "host_ms" in steps[0]["args"]
+    # A slice ENDS at its (aligned) journal timestamp: ts + dur == end.
+    a_step0 = next(
+        r for r in steps if r["pid"] == pids["host-a"] and r["name"] == "step 0"
+    )
+    assert a_step0["ts"] + a_step0["dur"] == pytest.approx((BASE + 100.0) * 1e6)
+    span = next(r for r in slices if r["cat"] == "span")
+    assert span["name"] == "train_step" and span["dur"] == pytest.approx(5e4)
+    instants = [r for r in rows if r["ph"] == "i"]
+    assert len(instants) == 12  # 6 sent + 6 observed heartbeats
+    assert all(r["s"] == "p" for r in instants)
+
+
+def test_observer_events_label_by_host_not_worker(tmp_path):
+    # heartbeat_observed carries worker=<observed>; it must land on the
+    # OBSERVER's process row, not the observed worker's.
+    paths = _write_fixture(tmp_path)
+    events, _ = merge_journals(paths)
+    observed = [e for e in events if e["kind"] == "heartbeat_observed"]
+    assert observed and all(e["trace_host"] == "sup" for e in observed)
+    trace = chrome_trace(events)
+    pids = {
+        r["args"]["name"]: r["pid"]
+        for r in trace["traceEvents"]
+        if r["ph"] == "M"
+    }
+    obs_rows = [
+        r
+        for r in trace["traceEvents"]
+        if r["ph"] == "i" and r["name"] == "heartbeat_observed"
+    ]
+    assert obs_rows and all(r["pid"] == pids["sup"] for r in obs_rows)
+
+
+def test_follow_journal_survives_rotation(tmp_path):
+    path = tmp_path / "live.jsonl"
+    rec = FlightRecorder(path=path, max_file_lines=5)
+    for i in range(3):
+        rec.record("tick", i=i)
+    state = {"phase": 0}
+
+    def fake_sleep(_):
+        # Each poll plays the next act: cross the rotation boundary
+        # (events 3-4 fill the file, os.replace moves it to .1), then
+        # append into the fresh live file, then signal stop.
+        if state["phase"] == 0:
+            for i in (3, 4):
+                rec.record("tick", i=i)  # rotates at the 5th line
+        elif state["phase"] == 1:
+            for i in (5, 6):
+                rec.record("tick", i=i)
+            rec.close()
+        state["phase"] += 1
+
+    got = [
+        ev["i"]
+        for ev in follow_journal(
+            path,
+            kind="tick",
+            poll_s=0.0,
+            sleep=fake_sleep,
+            stop=lambda: state["phase"] >= 3,
+        )
+    ]
+    assert got == list(range(7))  # nothing lost or duplicated across .1
+
+
+def test_follow_journal_filters_kind_and_skips_torn_lines(tmp_path):
+    path = tmp_path / "j.jsonl"
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps({"kind": "keep", "i": 0}) + "\n")
+        fh.write(json.dumps({"kind": "drop", "i": 1}) + "\n")
+        fh.write('{"kind": "keep", "i": 2')  # torn tail: no newline
+    got = list(
+        follow_journal(path, kind="keep", sleep=lambda _: None, stop=lambda: True)
+    )
+    assert [e["i"] for e in got] == [0]
+
+
+# -- CLI ----------------------------------------------------------------
+
+
+def test_cli_trace_writes_valid_chrome_json(tmp_path, capsys):
+    paths = _write_fixture(tmp_path)
+    out = tmp_path / "trace.json"
+    argv = ["trace", "--out", str(out)]
+    for p in paths:
+        argv += ["--journal", p]
+    assert main(argv) == 0
+    trace = json.loads(out.read_text(encoding="utf-8"))
+    assert trace["displayTimeUnit"] == "ms"
+    assert any(r["ph"] == "X" for r in trace["traceEvents"])
+    err = capsys.readouterr().err
+    summary = json.loads(err[err.index("{"):])
+    assert summary["clock"]["reference"] == "sup"
+    assert summary["clock"]["offsets"]["host-a"] == pytest.approx(-3.0)
+    assert summary["stragglers"]["top_straggler"] == "host-b"
+
+
+def test_cli_trace_stdout_and_no_align(tmp_path, capsys):
+    paths = _write_fixture(tmp_path)
+    argv = ["trace", "--no-align"]
+    for p in paths:
+        argv += ["--journal", p]
+    assert main(argv) == 0
+    captured = capsys.readouterr()
+    trace = json.loads(captured.out)
+    assert "traceEvents" in trace
+    assert json.loads(captured.err[captured.err.index("{"):])["clock"][
+        "aligned"
+    ] is False
+
+
+def test_cli_trace_missing_journal_fails(tmp_path, capsys):
+    assert main(["trace", "--journal", str(tmp_path / "nope.jsonl")]) == 1
+    assert "no journal" in capsys.readouterr().err
+
+
+def test_cli_trace_requires_a_journal():
+    with pytest.raises(SystemExit):
+        main(["trace"])
+
+
+def _virtual_profiled_journal(path):
+    class _Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = _Clock()
+    rec = FlightRecorder(path=path)
+    from deeplearning_cfn_tpu.obs.profiler import StepProfiler
+
+    prof = StepProfiler(name="train", clock=clock, recorder=rec)
+    prof.start()
+    for i in range(4):
+        with prof.phase("dispatch"):
+            clock.t += 0.002
+        with prof.sync_boundary():
+            clock.t += 0.008
+        prof.step_done(step=i)
+    prof.journal()
+    for step in range(3):
+        rec.record("step_time", worker="host-a", step=step, total_ms=50.0)
+        rec.record("step_time", worker="host-b", step=step, total_ms=80.0)
+    rec.close()
+
+
+def test_cli_status_profile_json(tmp_path, capsys):
+    path = tmp_path / "j.jsonl"
+    _virtual_profiled_journal(path)
+    assert main(["status", "--journal", str(path), "--profile"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    prof = out["profile"]["profilers"]["train"]
+    assert prof["steps"] == 4
+    assert prof["dispatch_ms"] == pytest.approx(2.0)
+    assert prof["compute_ms"] == pytest.approx(8.0)
+    assert prof["phases"]["dispatch"]["count"] == 4
+    assert out["profile"]["stragglers"]["top_straggler"] == "host-b"
+
+
+def test_cli_status_without_profile_flag_omits_block(tmp_path, capsys):
+    path = tmp_path / "j.jsonl"
+    _virtual_profiled_journal(path)
+    assert main(["status", "--journal", str(path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert "profile" not in out
+
+
+def test_cli_status_profile_prometheus(tmp_path, capsys):
+    path = tmp_path / "j.jsonl"
+    _virtual_profiled_journal(path)
+    assert (
+        main(["status", "--journal", str(path), "--profile", "--format", "prom"])
+        == 0
+    )
+    text = capsys.readouterr().out
+    assert "# TYPE dlcfn_step_phase_ms summary" in text
+    assert 'profiler="train"' in text and 'phase="dispatch"' in text
+    assert 'quantile="0.99"' in text
+    assert "dlcfn_step_ms_count" in text
+
+
+def test_cli_status_span_quantiles(tmp_path, capsys):
+    rec = FlightRecorder(path=tmp_path / "j.jsonl")
+    for _ in range(9):
+        rec.record("span", span="train_step", seconds=0.1, ok=True)
+    rec.record("span", span="train_step", seconds=1.0, ok=True)
+    rec.close()
+    assert main(["status", "--journal", str(tmp_path / "j.jsonl")]) == 0
+    spans = json.loads(capsys.readouterr().out)["spans"]["train_step"]
+    assert spans["count"] == 10
+    assert spans["p50_s"] == pytest.approx(0.1)
+    assert spans["p99_s"] == pytest.approx(1.0)
+    # The prom rendering grows a summary family for journal-fed spans.
+    assert (
+        main(["status", "--journal", str(tmp_path / "j.jsonl"), "--format", "prom"])
+        == 0
+    )
+    text = capsys.readouterr().out
+    assert "# TYPE dlcfn_span_seconds summary" in text
+    assert 'quantile="0.5"' in text and "dlcfn_span_seconds_sum" in text
