@@ -50,6 +50,7 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 # Findings anchor on the file that owns the audited step loop: the
 # baseline key is (rule, repo-relative path, message).
 AUDITED_FILE = REPO_ROOT / "deeplearning_cfn_tpu" / "train" / "trainer.py"
+SERVE_AUDITED_FILE = REPO_ROOT / "deeplearning_cfn_tpu" / "serve" / "engine.py"
 
 # jax_log_compiles emits exactly two shapes (jax 0.4.x):
 #   "Finished tracing + transforming {name} for pjit in {t} sec"
@@ -248,6 +249,9 @@ class PathAudit:
     new_traces: dict[str, int] = field(default_factory=dict)
     cache_size: int | None = None
     donation: DonationReport | None = None
+    # Which source file findings anchor on (the baseline key's path);
+    # None -> the trainer (the pre-serve audits' anchor).
+    audited_file: str | None = None
 
     @property
     def clean(self) -> bool:
@@ -291,19 +295,20 @@ def violations_for(paths: list[PathAudit]) -> list[Violation]:
     """
     out: list[Violation] = []
     for p in paths:
+        anchor = p.audited_file or str(AUDITED_FILE)
         if p.new_compiles:
             fns = ", ".join(sorted(p.new_compiles))
             out.append(
                 Violation(
                     rule=AUDIT_RULE_RETRACE,
-                    path=str(AUDITED_FILE),
+                    path=anchor,
                     line=1,
                     col=1,
                     message=(
-                        f"steady-state retrace on the {p.name} trainer "
-                        f"path: {fns} recompiled after warmup (compile-"
-                        "audit sentinel; see docs/STATIC_ANALYSIS.md "
-                        "retrace runbook)"
+                        f"steady-state retrace on the {p.name} path: "
+                        f"{fns} recompiled after warmup (compile-audit "
+                        "sentinel; see docs/STATIC_ANALYSIS.md retrace "
+                        "runbook)"
                     ),
                 )
             )
@@ -311,14 +316,14 @@ def violations_for(paths: list[PathAudit]) -> list[Violation]:
             out.append(
                 Violation(
                     rule=AUDIT_RULE_DONATION,
-                    path=str(AUDITED_FILE),
+                    path=anchor,
                     line=1,
                     col=1,
                     message=(
                         f"state donation ineffective on the {p.name} "
-                        "trainer path: no input buffer was deleted by the "
-                        "step (donate_argnums dropped or aliasing "
-                        "declined; compile-audit sentinel)"
+                        "path: no input buffer was deleted by the step "
+                        "(donate_argnums dropped or aliasing declined; "
+                        "compile-audit sentinel)"
                     ),
                 )
             )
@@ -422,6 +427,114 @@ def run_compile_audit(
         multi.cache_size = _cache_size(kfn)
         paths.append(multi)
         jax.block_until_ready(state.params)
+        snapshot = watcher.snapshot()
+
+    violations = violations_for(paths)
+    if journal:
+        from deeplearning_cfn_tpu.obs.recorder import get_recorder
+
+        get_recorder().record(
+            "compile_audit",
+            clean=not violations,
+            compile_count=snapshot["compile_count"],
+            retrace_count=snapshot["retrace_count"],
+            backend_compiles=snapshot["backend_compiles"],
+            paths={p.name: p.to_dict() for p in paths},
+        )
+    return CompileAuditReport(paths=paths, watcher=snapshot, violations=violations)
+
+
+def run_serve_audit(
+    steady_requests: int = 24,
+    journal: bool = True,
+) -> CompileAuditReport:
+    """The serving-plane sentinel: continuous batching must reach ONE
+    compiled decode step and stay there.
+
+    Warms a tiny engine (one request through prefill + decode compiles
+    both jits), marks steady, then pushes ``steady_requests`` requests of
+    MIXED prompt/output lengths through the scheduler — every admission,
+    every occupancy pattern, every page placement must hit the same two
+    executables.  Any post-warmup compile is a DLC410 finding anchored on
+    serve/engine.py; a decode step that stops donating the paged pool
+    (two pool-sized buffers resident per step) is a DLC411 finding.
+    """
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from deeplearning_cfn_tpu.models.llama import LlamaConfig, init_params
+    from deeplearning_cfn_tpu.serve.engine import (
+        ContinuousBatchingEngine,
+        ServeConfig,
+        ServeRequest,
+        paged_decode_step,
+    )
+
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny(vocab_size=64, seq_len=64), dtype=jnp.float32
+    )
+    params = init_params(cfg, jax.random.key(0))
+    scfg = ServeConfig(
+        num_slots=4, block_size=4, blocks_per_slot=8, prefill_len=16
+    )
+    engine = ContinuousBatchingEngine(
+        cfg, params, scfg, clock=lambda: 0.0, journal=False
+    )
+    rng = np.random.default_rng(0)
+
+    def make_request(i: int) -> ServeRequest:
+        prompt = rng.integers(0, 64, size=int(rng.integers(1, 17)))
+        return ServeRequest(
+            f"audit-{i}", prompt.astype(np.int32), int(rng.integers(1, 17))
+        )
+
+    paths: list[PathAudit] = []
+    with CompileWatcher() as watcher:
+        engine.submit(make_request(0))
+        while engine.pending():
+            engine.step()
+        watcher.mark_steady()
+
+        decode_steps = 0
+        for i in range(1, steady_requests + 1):
+            engine.submit(make_request(i))
+        while engine.pending():
+            engine.step()
+            decode_steps += 1
+
+        audit = PathAudit(
+            name="serve_decode",
+            steady_steps=decode_steps,
+            new_compiles=watcher.new_compiles_since_mark(),
+            new_traces=watcher.new_traces_since_mark(),
+            cache_size=_cache_size(paged_decode_step),
+            audited_file=str(SERVE_AUDITED_FILE),
+        )
+        # Donation check on the real steady-state call: the paged pool
+        # must be consumed (deleted), not copied, by the decode step.
+        scfg_t = engine.serve_cfg
+        tokens = np.zeros(scfg_t.num_slots, np.int32)
+        lengths = np.zeros(scfg_t.num_slots, np.int32)
+        tables = np.zeros(
+            (scfg_t.num_slots, scfg_t.blocks_per_slot), np.int32
+        )
+        active = np.zeros(scfg_t.num_slots, bool)
+        (_, engine.cache), audit.donation = measure_donation(
+            lambda cache: paged_decode_step(
+                cfg,
+                engine.params,
+                cache,
+                tokens,
+                lengths,
+                tables,
+                active,
+                engine._key,
+                temperature=scfg_t.temperature,
+            ),
+            engine.cache,
+        )
+        paths.append(audit)
         snapshot = watcher.snapshot()
 
     violations = violations_for(paths)

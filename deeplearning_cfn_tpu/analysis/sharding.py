@@ -67,7 +67,7 @@ AUDIT_RULE_RETRACE = "DLC410"
 AUDIT_RULE_DONATION = "DLC411"
 AUDIT_RULE_IDS = (AUDIT_RULE_RETRACE, AUDIT_RULE_DONATION)
 
-_COMPUTE_DIRS = ("train", "models", "ops")
+_COMPUTE_DIRS = ("train", "models", "ops", "serve")
 
 
 def _applies_compute_paths(path: Path) -> bool:

@@ -1078,6 +1078,227 @@ def straggler(seed: int) -> ScenarioReport:
     return report
 
 
+# --- serve-replica-loss ------------------------------------------------------
+
+
+def serve_replica_loss(seed: int) -> ScenarioReport:
+    """A serving replica dies mid-traffic; no accepted request may be lost.
+
+    Drives the real serving plane end-to-end on virtual time: two
+    :class:`ServeReplica` engines behind a :class:`ServeFrontEnd`, seeded
+    Poisson traffic from the load generator, replica liveness beating a
+    :class:`SimBroker`, and the elasticity controller's
+    ``on_instance_loss`` seam wired to the front-end's failover.  Mid-run
+    an ``INSTANCE_TERMINATE`` for a seed-picked victim kills one replica;
+    its in-flight requests replay onto the survivor with their original
+    arrival times.
+
+    Invariants: every accepted request completes (zero loss); greedy
+    outputs are identical to an undisturbed single-engine reference run
+    (failover is invisible in content, visible only in latency); p99
+    per-token latency and p99 TTFT stay inside the SLO even through the
+    disruption; the victim's heartbeat goes silent while the survivor
+    keeps beating; the failover is journaled exactly once.
+    """
+    from deeplearning_cfn_tpu.analysis.schedules import (
+        SimBroker,
+        SimBrokerConnection,
+        VirtualClock,
+    )
+    from deeplearning_cfn_tpu.cluster.elasticity import (
+        ElasticityController,
+        GroupPolicy,
+    )
+    from deeplearning_cfn_tpu.provision.events import (
+        EventBus,
+        EventKind,
+        LifecycleEvent,
+    )
+
+    # Import order: the serve engine imports jax; chaos runs under
+    # `dlcfn chaos` where conftest's XLA flags may be absent.  The engine
+    # is single-device (colocated), so no device-count guard is needed.
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning_cfn_tpu.models.llama import LlamaConfig, init_params
+    from deeplearning_cfn_tpu.serve import (
+        ContinuousBatchingEngine,
+        ServeConfig,
+        ServeFrontEnd,
+        ServeReplica,
+        TrafficConfig,
+        run_load,
+    )
+
+    # SLOs asserted through the disruption (virtual milliseconds; the
+    # traffic model charges 10ms/step + 4ms/prefill, so these bound
+    # QUEUEING, deterministically, not host FLOPs).
+    slo_per_token_p99_ms = 150.0
+    slo_ttft_p99_ms = 250.0
+
+    report = ScenarioReport("serve-replica-loss", seed)
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny(vocab_size=64, seq_len=64), dtype=jnp.float32
+    )
+    params = init_params(cfg, jax.random.key(0))
+    scfg = ServeConfig(
+        num_slots=4, block_size=4, blocks_per_slot=8, prefill_len=16
+    )
+    tcfg = TrafficConfig(requests=80, seed=seed)
+
+    def make_engine(clock, name):
+        return ContinuousBatchingEngine(
+            cfg, params, scfg, clock=clock, name=name, journal=False
+        )
+
+    # --- undisturbed single-engine reference (expected outputs) --------
+    ref_clock = VirtualClock()
+    reference = run_load(make_engine(ref_clock, "ref"), tcfg, ref_clock)
+
+    # --- live run: 2 replicas, broker liveness, terminate mid-traffic --
+    vclock = VirtualClock()
+    broker = SimBroker(vclock)
+
+    class _KV:
+        """Broker KV verbs a register() needs (a BrokerConnection.set
+        stand-in; same key/value contract)."""
+
+        def __init__(self):
+            self.table: dict[str, str] = {}
+
+        def set(self, key: str, value: str) -> None:
+            self.table[key] = value
+
+    class _Backend:
+        """Event-plane-only backend (the elasticity controller only
+        touches .events for terminate handling)."""
+
+        def __init__(self):
+            self.events = EventBus()
+
+    kv = _KV()
+    replicas = [
+        ServeReplica(
+            make_engine(vclock, f"rep{i}"),
+            f"rep{i}",
+            group="serve",
+            connection_factory=lambda: SimBrokerConnection(broker),
+        )
+        for i in range(2)
+    ]
+    for r in replicas:
+        r.register(kv)
+    frontend = ServeFrontEnd(replicas)
+
+    backend = _Backend()
+    controller = ElasticityController(
+        backend=backend,
+        coordinator_queue_name="coord",
+        on_instance_loss=frontend.on_instance_loss,
+        clock=vclock,
+    )
+    controller.register(GroupPolicy("serve", 1, "sig-serve"))
+    controller.attach()
+
+    victim = f"rep{seed % 2}"
+    survivor = f"rep{1 - seed % 2}"
+    kill_step = 20 + seed % 7
+    failover_before = _journal_count("serve_failover")
+    lost_before = _journal_count("instance_lost")
+    killed: list[str] = []
+
+    def on_step(step: int) -> None:
+        # Live replicas beat every scheduler step; a failed one falls out
+        # of the front-end and goes silent — exactly what the liveness
+        # watcher would escalate.
+        for rep in frontend.replicas.values():
+            rep.beat()
+        if step == kill_step and not killed:
+            killed.append(victim)
+            backend.events.publish(
+                LifecycleEvent(
+                    kind=EventKind.INSTANCE_TERMINATE,
+                    group="serve",
+                    instance_id=f"serve/{victim}",
+                    detail={"reason": "chaos"},
+                )
+            )
+
+    load = run_load(frontend, tcfg, vclock, on_step=on_step)
+
+    report.check(
+        load.completed == tcfg.requests and not frontend.lost_requests(),
+        f"zero lost accepted requests: all {tcfg.requests} completed "
+        "through the replica death",
+    )
+    report.check(
+        frontend.failed == [victim]
+        and f"serve/{victim}" in controller.lost_instances,
+        "the terminate event reached the front-end through the "
+        "elasticity controller's on_instance_loss seam",
+    )
+    report.check(
+        load.completions == reference.completions,
+        "greedy outputs identical to the undisturbed single-engine "
+        "reference — failover is invisible in content",
+    )
+    per_token_p99 = load.latency_per_token_ms.get("p99", float("inf"))
+    ttft_p99 = load.ttft_ms.get("p99", float("inf"))
+    report.check(
+        per_token_p99 <= slo_per_token_p99_ms,
+        f"p99 per-token latency {per_token_p99}ms inside the "
+        f"{slo_per_token_p99_ms}ms SLO through the disruption",
+    )
+    report.check(
+        ttft_p99 <= slo_ttft_p99_ms,
+        f"p99 TTFT {ttft_p99}ms inside the {slo_ttft_p99_ms}ms SLO "
+        "through the disruption",
+    )
+    victim_silence = broker.silence_s(f"serve/{victim}")
+    survivor_silence = broker.silence_s(f"serve/{survivor}")
+    report.check(
+        victim_silence is not None
+        and survivor_silence is not None
+        and victim_silence > survivor_silence,
+        "victim's heartbeat went silent while the survivor kept beating",
+    )
+    report.check(
+        _journal_count("serve_failover") - failover_before == 1
+        and _journal_count("instance_lost") - lost_before == 1,
+        "journal shows exactly one failover and one instance loss",
+    )
+    report.check(
+        sorted(kv.table) == ["serve/serve/rep0", "serve/serve/rep1"],
+        "both replicas registered in the broker KV table",
+    )
+    checksum = int(
+        np.sum(
+            [np.sum(tokens, dtype=np.int64) for tokens in load.completions.values()],
+            dtype=np.int64,
+        )
+    )
+    report.details.update(
+        victim=victim,
+        kill_step=kill_step,
+        replayed=sorted(frontend.replayed),
+        requests=tcfg.requests,
+        steps=load.steps,
+        duration_s=load.duration_s,
+        throughput_rps=load.throughput_rps,
+        tokens_out=load.tokens_out,
+        output_checksum=checksum,
+        ttft_p99_ms=ttft_p99,
+        per_token_p99_ms=per_token_p99,
+        reference_steps=reference.steps,
+        victim_silence_s=round(victim_silence or 0.0, 6),
+    )
+    return report
+
+
 SCENARIOS: dict[str, Callable[[int], ScenarioReport]] = {
     "silent-death": silent_death,
     "partition": partition,
@@ -1085,6 +1306,7 @@ SCENARIOS: dict[str, Callable[[int], ScenarioReport]] = {
     "slow-disk": slow_disk,
     "slice-loss-live": slice_loss_live,
     "straggler": straggler,
+    "serve-replica-loss": serve_replica_loss,
 }
 
 

@@ -682,6 +682,20 @@ def _status_reshard(args) -> dict | None:
     return fold_reshard_events(read_journal(args.journal)) or None
 
 
+def _status_serve(args) -> dict | None:
+    """Per-replica serving snapshots folded from journaled
+    ``serve_metrics`` events (latest per replica wins), or None
+    (``--serve`` not passed / no journal / no serving events).  Feeds the
+    ``dlcfn_serve_*`` gauges in the Prometheus rendering."""
+    if not getattr(args, "serve", False) or not args.journal:
+        return None
+    from deeplearning_cfn_tpu.obs.exporter import fold_serve_events
+    from deeplearning_cfn_tpu.obs.recorder import read_journal
+
+    folded = fold_serve_events(read_journal(args.journal, kind="serve_metrics"))
+    return dict(sorted(folded.items())) or None
+
+
 def _status_mesh(args) -> dict | None:
     """The current mesh shape straight from the published cluster
     contract (slices/workers/chips and the degraded flag) — after a live
@@ -769,6 +783,7 @@ def cmd_status(args) -> int:
     reshard = _status_reshard(args)
     mesh = _status_mesh(args)
     profile = _status_profile(args)
+    serve = _status_serve(args)
     workers = _status_metrics(args.metrics_dir) if args.metrics_dir else None
     if args.metrics_dir and workers is None:
         print(f"no metrics under {args.metrics_dir}", file=sys.stderr)
@@ -785,6 +800,7 @@ def cmd_status(args) -> int:
                 reshard=reshard,
                 mesh=mesh,
                 profile=profile,
+                serve=serve,
             ),
             end="",
         )
@@ -796,6 +812,7 @@ def cmd_status(args) -> int:
         and mesh is None
         and reshard is None
         and profile is None
+        and serve is None
     ):
         # Metrics-only: the original (round-4) output shape, unchanged.
         print(json.dumps(workers, indent=2))
@@ -813,6 +830,8 @@ def cmd_status(args) -> int:
         out["input_pipeline"] = pipeline
     if profile is not None:
         out["profile"] = profile
+    if serve is not None:
+        out["serve"] = serve
     if workers is not None:
         out["workers"] = workers
     print(json.dumps(out, indent=2))
@@ -1089,6 +1108,98 @@ def cmd_lint(args) -> int:
     return 1 if violations else 0
 
 
+def cmd_serve(args) -> int:
+    """dlcfn serve: run the serving plane under deterministic synthetic
+    traffic and print the load report (docs/SERVING.md).
+
+    Spins up ``--replicas`` continuous-batching engines behind a
+    least-loaded front-end and drives them with seeded Poisson traffic
+    on a virtual clock — the operator's smoke of the whole plane
+    (admission, paging, continuous batching, metrics).  With ``--broker``
+    each replica registers in the broker's KV table
+    (``serve/<group>/<name>``) and beats the liveness table every
+    scheduler step, exactly like a training worker; with
+    ``--disaggregate`` prefill runs on a dedicated device where the
+    topology has one to spare.  ``--journal`` (or
+    ``$DLCFN_FLIGHT_JOURNAL``) records per-replica ``serve_metrics``
+    events, which ``dlcfn status --serve`` and the Prometheus exporter
+    fold into the ``dlcfn_serve_*`` gauges."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning_cfn_tpu.analysis.schedules import VirtualClock
+    from deeplearning_cfn_tpu.models.llama import LlamaConfig, init_params
+    from deeplearning_cfn_tpu.serve import (
+        ContinuousBatchingEngine,
+        ServeConfig,
+        ServeFrontEnd,
+        ServeReplica,
+        TrafficConfig,
+        plan_placement,
+        run_load,
+    )
+
+    if args.journal:
+        os.environ["DLCFN_FLIGHT_JOURNAL"] = args.journal
+    # The demo model: the flagship transformer at toy scale (the plane's
+    # behavior — admission, paging, batching — is model-size-independent;
+    # checkpoint-loading serve is the ROADMAP's next step).
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny(vocab_size=64, seq_len=64), dtype=jnp.float32
+    )
+    params = init_params(cfg, jax.random.key(0))
+    scfg = ServeConfig(
+        num_slots=args.slots, block_size=4, blocks_per_slot=8, prefill_len=16
+    )
+    placement = plan_placement() if args.disaggregate else None
+    clock = VirtualClock()
+    conn = None
+    if args.serve_broker:
+        from deeplearning_cfn_tpu.cluster.broker_client import BrokerConnection
+
+        host, _, port = args.serve_broker.partition(":")
+        conn = BrokerConnection(host, int(port))
+    replicas = []
+    for i in range(args.replicas):
+        engine = ContinuousBatchingEngine(
+            cfg,
+            params,
+            scfg,
+            clock=clock,
+            name=f"rep{i}",
+            placement=placement,
+        )
+        replica = ServeReplica(
+            engine,
+            f"rep{i}",
+            group=args.group,
+            connection_factory=(lambda: conn) if conn is not None else None,
+        )
+        if conn is not None:
+            replica.register(conn)
+        replicas.append(replica)
+    frontend = ServeFrontEnd(replicas)
+    traffic = TrafficConfig(requests=args.requests, seed=args.seed)
+
+    def beat_all(_step: int) -> None:
+        for replica in frontend.replicas.values():
+            replica.beat()
+
+    report = run_load(
+        frontend,
+        traffic,
+        clock,
+        on_step=beat_all if conn is not None else None,
+        journal=True,
+    )
+    for replica in frontend.replicas.values():
+        replica.engine.journal_metrics()
+    print(json.dumps(report.to_dict(), indent=2))
+    return 0 if report.completed == traffic.requests else 1
+
+
 def cmd_chaos(args) -> int:
     """dlcfn chaos: run named fault-injection scenarios (docs/RESILIENCE.md).
 
@@ -1295,6 +1406,10 @@ def main(argv: list[str] | None = None) -> int:
                          "(per-phase p50/p95/p99) and, when step_time "
                          "events span 2+ hosts, the slowest-host-per-step "
                          "straggler table")
+    ps.add_argument("--serve", action="store_true",
+                    help="with --journal: per-replica serving snapshots "
+                         "(slots, queue depth, TTFT quantiles, tokens/s) "
+                         "folded from serve_metrics events")
     ps.set_defaults(fn=cmd_status)
     # events tails the flight recorder's journal.
     pe = sub.add_parser("events", help="tail the obs flight journal")
@@ -1327,13 +1442,35 @@ def main(argv: list[str] | None = None) -> int:
                          "(merge on raw per-host timestamps)")
     pt.set_defaults(fn=cmd_trace)
     # chaos runs named fault-injection scenarios against real components.
+    pv = sub.add_parser(
+        "serve",
+        help="continuous-batching inference replicas under synthetic traffic",
+    )
+    pv.add_argument("--requests", type=int, default=200,
+                    help="synthetic requests to serve")
+    pv.add_argument("--seed", type=int, default=0,
+                    help="traffic seed; the run is deterministic per seed")
+    pv.add_argument("--replicas", type=int, default=1,
+                    help="engines behind the front-end")
+    pv.add_argument("--slots", type=int, default=4,
+                    help="decode slots per replica")
+    pv.add_argument("--group", default="serve",
+                    help="worker-group name for registration/liveness")
+    pv.add_argument("--broker", default=None, dest="serve_broker",
+                    metavar="HOST:PORT",
+                    help="register replicas and beat liveness at this broker")
+    pv.add_argument("--disaggregate", action="store_true",
+                    help="prefill on a dedicated device when >= 2 devices")
+    pv.add_argument("--journal", default=None,
+                    help="flight journal path for serve_metrics events")
+    pv.set_defaults(fn=cmd_serve)
     px = sub.add_parser(
         "chaos", help="run seeded fault-injection scenarios (resilience soak)"
     )
     px.add_argument("--scenario", default=None,
                     help="scenario name (see --list): silent-death, "
                          "partition, flaky-rpc, slow-disk, slice-loss-live, "
-                         "straggler")
+                         "straggler, serve-replica-loss")
     px.add_argument("--seed", type=int, default=0,
                     help="fault-schedule seed; reports are deterministic "
                          "per (scenario, seed)")

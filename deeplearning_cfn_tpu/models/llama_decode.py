@@ -71,6 +71,22 @@ def _flat_layers(cfg: LlamaConfig, params: dict) -> dict:
     return layers
 
 
+def sample_token(
+    logits: jax.Array,  # [..., V] float32
+    key: jax.Array,
+    temperature: float,
+) -> jax.Array:
+    """Greedy argmax at temperature 0.0, else ``categorical(logits / T)``.
+
+    Shared by :func:`generate` and the serving plane's paged decode step
+    (serve/engine.py) so both paths sample with byte-identical math —
+    the bit-parity contract in tests/test_serve.py depends on it.
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(key, logits / temperature).astype(jnp.int32)
+
+
 def _attend_cached(
     q: jax.Array,  # [B, S, H, D]
     cache_k: jax.Array,  # [B, max_seq, Hkv, D]
@@ -179,9 +195,7 @@ def generate(
     )
 
     def sample(logits_1, key):
-        if temperature == 0.0:
-            return jnp.argmax(logits_1, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(key, logits_1 / temperature).astype(jnp.int32)
+        return sample_token(logits_1, key, temperature)
 
     keys = jax.random.split(rng, max_new_tokens)
     first = sample(logits[:, -1], keys[0])

@@ -56,6 +56,38 @@ def fold_reshard_events(events) -> dict[str, Any]:
     return out
 
 
+def fold_serve_events(events) -> dict[str, Any]:
+    """Fold flight-journal ``serve_metrics`` events into the latest
+    snapshot per replica (each journal write is a full snapshot, so
+    last-wins is the fold).  Empty dict when no replica ever reported."""
+    out: dict[str, Any] = {}
+    for event in events:
+        if event.get("kind") != "serve_metrics":
+            continue
+        replica = str(event.get("replica") or "?")
+        out[replica] = {
+            k: event.get(k)
+            for k in (
+                "steps",
+                "admitted",
+                "completed",
+                "rejected",
+                "active_slots",
+                "queue_depth",
+                "tokens_out",
+                "tokens_per_s",
+                "ttft_ms",
+                "itl_ms",
+                "free_blocks",
+                "recycled_blocks",
+                "max_wait_steps",
+                "kv_transfer_bytes",
+                "disaggregated",
+            )
+        }
+    return out
+
+
 def render_prometheus(
     liveness: Mapping[str, Mapping[str, Any]] | None = None,
     spans: Mapping[str, Mapping[str, Any]] | None = None,
@@ -64,6 +96,7 @@ def render_prometheus(
     reshard: Mapping[str, Any] | None = None,
     mesh: Mapping[str, Any] | None = None,
     profile: Mapping[str, Any] | None = None,
+    serve: Mapping[str, Mapping[str, Any]] | None = None,
 ) -> str:
     """Render liveness snapshot + span aggregates + input-pipeline
     counters as Prometheus text.
@@ -257,5 +290,43 @@ def render_prometheus(
                 f"dlcfn_step_ms_count"
                 f"{_labels(cluster=cluster, profiler=prof_name)}"
                 f" {snap.get('steps', 0)}"
+            )
+    if serve:
+        for key, help_text in (
+            ("active_slots", "Decode slots currently occupied on the replica."),
+            ("queue_depth", "Requests admitted but not yet slotted."),
+            ("tokens_per_s", "Sampled tokens per second (replica lifetime)."),
+        ):
+            lines += [
+                f"# HELP dlcfn_serve_{key} {help_text}",
+                f"# TYPE dlcfn_serve_{key} gauge",
+            ]
+            for replica, snap in serve.items():
+                value = snap.get(key)
+                if value is None:
+                    continue
+                lines.append(
+                    f"dlcfn_serve_{key}"
+                    f"{_labels(cluster=cluster, replica=replica)} {value}"
+                )
+        lines += [
+            "# HELP dlcfn_serve_ttft_ms Time-to-first-token quantiles (replica lifetime).",
+            "# TYPE dlcfn_serve_ttft_ms summary",
+        ]
+        for replica, snap in serve.items():
+            ttft = snap.get("ttft_ms") or {}
+            for quantile, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+                value = ttft.get(key)
+                if value is None:
+                    continue
+                lines.append(
+                    f"dlcfn_serve_ttft_ms"
+                    f"{_labels(cluster=cluster, replica=replica, quantile=quantile)}"
+                    f" {value}"
+                )
+            lines.append(
+                f"dlcfn_serve_ttft_ms_count"
+                f"{_labels(cluster=cluster, replica=replica)}"
+                f" {snap.get('admitted', 0)}"
             )
     return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,52 @@
+"""Serving plane: continuous-batching LLM inference as a cluster workload.
+
+The training stack ends at a checkpoint; this package is the other half
+of the north star — the online service that turns `models/llama_decode`
+into a workload the cluster planes (broker, elasticity, obs, chaos)
+manage exactly like training:
+
+- :mod:`~deeplearning_cfn_tpu.serve.paged_cache` — a slot-based paged
+  K/V pool: block-granular pages + per-slot block tables, so requests of
+  different lengths share ONE compiled decode step and freed pages
+  recycle without reallocation.
+- :mod:`~deeplearning_cfn_tpu.serve.engine` — the jitted prefill/decode
+  steps over the paged pool and the continuous-batching scheduler that
+  admits new requests into in-flight batches at step boundaries.
+- :mod:`~deeplearning_cfn_tpu.serve.replica` — `ServeReplica` (broker
+  registration + liveness heartbeat around one engine) and
+  `ServeFrontEnd` (routing + zero-loss replay of accepted requests
+  across replica death, driven by the elasticity controller).
+- :mod:`~deeplearning_cfn_tpu.serve.loadgen` — deterministic synthetic
+  traffic (Poisson arrivals, seeded lengths) on `VirtualClock`, the
+  harness behind the soak test, perf-smoke stage, and the
+  ``serve-replica-loss`` chaos scenario.
+
+docs/SERVING.md is the operator-facing tour.
+"""
+
+from deeplearning_cfn_tpu.serve.engine import (  # noqa: F401
+    Completion,
+    ContinuousBatchingEngine,
+    ServeAdmissionError,
+    ServeConfig,
+    ServeRequest,
+)
+from deeplearning_cfn_tpu.serve.loadgen import (  # noqa: F401
+    LoadReport,
+    TrafficConfig,
+    generate_traffic,
+    run_load,
+)
+from deeplearning_cfn_tpu.serve.paged_cache import (  # noqa: F401
+    BlockAllocator,
+    PagedKVCache,
+    init_paged_cache,
+)
+from deeplearning_cfn_tpu.serve.placement import (  # noqa: F401
+    ServePlacement,
+    plan_placement,
+)
+from deeplearning_cfn_tpu.serve.replica import (  # noqa: F401
+    ServeFrontEnd,
+    ServeReplica,
+)

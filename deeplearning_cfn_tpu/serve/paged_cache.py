@@ -1,0 +1,96 @@
+"""Slot-based paged K/V cache: fixed block pool + per-slot block tables.
+
+The decode path's :class:`~deeplearning_cfn_tpu.models.llama_decode.KVCache`
+is one contiguous ``[L, B, max_seq, Hkv, D]`` buffer per generation call —
+perfect for a single batched `generate`, wrong for serving, where requests
+arrive and finish at different times and lengths.  This module keeps the
+static-shape discipline (the whole pool is allocated once, every jitted
+step sees the same shapes) but makes *placement* dynamic:
+
+- the pool is ``[L, num_blocks, block_size, Hkv, D]`` — K/V pages of
+  ``block_size`` tokens;
+- each active slot owns an ordered list of physical block ids (its block
+  table); token ``p`` of a slot lives at ``(table[p // bs], p % bs)``;
+- a finished request returns its blocks to the host-side free list, so
+  admission never reallocates device memory — pages recycle.
+
+Scatter for inactive slots routes the write to an out-of-range block index
+under ``mode="drop"``; gathers through padded table entries read live
+pages owned by other slots, but the attention validity mask zeroes their
+weights, so no cross-request leakage reaches any output.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning_cfn_tpu.models.llama import LlamaConfig
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class PagedKVCache:
+    """Per-layer paged K/V pool, layer axis leading (scan carry)."""
+
+    k: jax.Array  # [L, num_blocks, block_size, Hkv, D]
+    v: jax.Array
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+
+def init_paged_cache(
+    cfg: LlamaConfig, num_blocks: int, block_size: int
+) -> PagedKVCache:
+    shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads, cfg.head_dim)
+    return PagedKVCache(
+        k=jnp.zeros(shape, cfg.dtype),
+        v=jnp.zeros(shape, cfg.dtype),
+    )
+
+
+class BlockAllocator:
+    """Host-side free list over the pool's physical block ids.
+
+    Allocation is all-or-nothing (a request needs its whole table before
+    prefill) and lowest-id-first, so a given admission order always
+    produces the same physical placement — placement determinism is what
+    makes the soak and chaos reports byte-identical per seed.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks <= 0:
+            raise ValueError(f"pool needs at least one block, got {num_blocks}")
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, -1, -1))  # pop() -> lowest id
+        self.recycled = 0  # blocks returned by finished requests
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> list[int] | None:
+        """``n`` block ids, or None (allocation deferred) if short."""
+        if n <= 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            if not 0 <= b < self.num_blocks:
+                raise ValueError(f"block id {b} outside pool of {self.num_blocks}")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+        self._free.extend(sorted(blocks, reverse=True))
+        self._free.sort(reverse=True)
+        self.recycled += len(blocks)

@@ -1,0 +1,59 @@
+"""Prefill/decode placement: disaggregate the two phases where topology allows.
+
+Prefill is compute-bound (one big batched forward per admission); decode is
+memory-bandwidth-bound (one token per slot per step, the paged pool resident).
+On a multi-device host the engine can therefore run them on SEPARATE devices:
+prompts prefill on a dedicated device via :func:`engine.prefill_kv` (local
+causal attention, no pool), the resulting K/V transfers once, and
+:func:`engine.scatter_prompt_kv` lands it in the decode device's pool — the
+decode step is never stalled behind a long prompt's compute.
+
+``plan_placement`` is deliberately conservative: disaggregation needs at
+least two devices, and a single-device topology (the CPU CI case) falls
+back to the colocated path — the one that is bit-pinned to
+``llama_decode.generate`` by the parity test.  The disaggregated path is
+numerically equivalent but not bit-identical (its prefill attention
+reduces over ``prefill_len`` instead of the gathered ``max_context``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+
+
+@dataclass(frozen=True)
+class ServePlacement:
+    """Which devices run which phase of serving."""
+
+    prefill_devices: tuple = field(default_factory=tuple)
+    decode_devices: tuple = field(default_factory=tuple)
+    disaggregated: bool = False
+
+    def describe(self) -> dict:
+        return {
+            "disaggregated": self.disaggregated,
+            "prefill_devices": [str(d) for d in self.prefill_devices],
+            "decode_devices": [str(d) for d in self.decode_devices],
+        }
+
+
+def plan_placement(devices: list | None = None) -> ServePlacement:
+    """Choose a placement for one replica on the local topology.
+
+    >= 2 devices: device 0 prefills, the rest decode (disaggregated).
+    1 device: colocated — both phases share it (the parity-tested path).
+    """
+    devices = list(devices) if devices is not None else list(jax.local_devices())
+    if len(devices) >= 2:
+        return ServePlacement(
+            prefill_devices=(devices[0],),
+            decode_devices=tuple(devices[1:]),
+            disaggregated=True,
+        )
+    return ServePlacement(
+        prefill_devices=tuple(devices),
+        decode_devices=tuple(devices),
+        disaggregated=False,
+    )

@@ -16,10 +16,21 @@ python -m deeplearning_cfn_tpu.cli lint --concurrency --protocol --sharding \
 
 echo "== chaos scenarios (seeded, virtual-clock — docs/RESILIENCE.md) =="
 # --all includes slice-loss-live, which drives a real 2-slice SPMD trainer
-# and needs 8 virtual CPU devices before the JAX backend initializes.
+# and needs 8 virtual CPU devices before the JAX backend initializes, and
+# serve-replica-loss, which kills a serving replica mid-traffic and
+# asserts zero lost accepted requests plus the p99 latency SLO
+# (docs/SERVING.md runbook).
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -m deeplearning_cfn_tpu.cli chaos --all --seed 0 \
   > /tmp/_chaos.json || { cat /tmp/_chaos.json; exit 1; }
+python - <<'EOF' || exit 1
+# The serving plane's SLO gate must actually have run: --all is dynamic,
+# so pin the one scenario this gate newly depends on.
+import json
+reports = json.load(open("/tmp/_chaos.json"))
+names = {r["scenario"] for r in reports}
+assert "serve-replica-loss" in names, f"serve-replica-loss missing from {sorted(names)}"
+EOF
 echo "chaos: all scenarios held their invariants (report: /tmp/_chaos.json)"
 
 echo "== perf-smoke (compact-dtype input path, structural asserts only) =="

@@ -42,7 +42,10 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = parser.parse_args(argv)
 
-    from deeplearning_cfn_tpu.analysis.compile_audit import run_compile_audit
+    from deeplearning_cfn_tpu.analysis.compile_audit import (
+        run_compile_audit,
+        run_serve_audit,
+    )
     from deeplearning_cfn_tpu.analysis.runner import (
         DEFAULT_BASELINE,
         apply_baseline,
@@ -53,6 +56,15 @@ def main(argv: list[str] | None = None) -> int:
     report = run_compile_audit(
         steady_steps=args.steps, warmup_steps=args.warmup, k=args.k
     )
+    # The serving plane rides the same ratchet: its continuous-batching
+    # decode must stay on one compiled step across mixed-length traffic.
+    serve_report = run_serve_audit()
+    report.paths.extend(serve_report.paths)
+    report.violations.extend(serve_report.violations)
+    for key in ("compile_count", "retrace_count", "backend_compiles"):
+        report.watcher[key] = report.watcher.get(key, 0) + serve_report.watcher.get(
+            key, 0
+        )
 
     baseline_path = args.baseline if args.baseline is not None else DEFAULT_BASELINE
     baseline = load_baseline(baseline_path) if baseline_path.exists() else set()

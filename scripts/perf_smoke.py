@@ -122,6 +122,91 @@ def profiler_overhead() -> dict:
     }
 
 
+SERVE_REQUESTS = 40
+SERVE_STARVATION_BOUND = 80  # scheduler steps a queued request may wait
+
+
+def serve_scheduler() -> tuple[dict, list[str]]:
+    """Serving-plane scheduler stage: structural asserts only, no
+    wall-clock.  Drives seeded mixed-length traffic through one
+    continuous-batching engine on a virtual clock and checks the
+    scheduler's contracts: occupancy never exceeds the slot count, FIFO
+    admission never starves a request beyond a generous step bound, every
+    accepted request completes, and the decode path stays on its single
+    post-warmup compile (the DLC410 property, observed live)."""
+    import dataclasses
+
+    from deeplearning_cfn_tpu.analysis.compile_audit import CompileWatcher
+    from deeplearning_cfn_tpu.analysis.schedules import VirtualClock
+    from deeplearning_cfn_tpu.models.llama import LlamaConfig, init_params
+    from deeplearning_cfn_tpu.serve import (
+        ContinuousBatchingEngine,
+        ServeConfig,
+        ServeRequest,
+        TrafficConfig,
+        run_load,
+    )
+
+    failures: list[str] = []
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny(vocab_size=64, seq_len=64), dtype=jnp.float32
+    )
+    params = init_params(cfg, jax.random.key(0))
+    scfg = ServeConfig(
+        num_slots=4, block_size=4, blocks_per_slot=8, prefill_len=16
+    )
+    clock = VirtualClock()
+    engine = ContinuousBatchingEngine(
+        cfg, params, scfg, clock=clock, journal=False
+    )
+    # Warmup: one request compiles the prefill and decode executables.
+    engine.submit(ServeRequest("warm", np.array([1, 2, 3], np.int32), 4))
+    while engine.pending():
+        engine.step()
+
+    occupancy_ok = True
+
+    def watch_occupancy(_step: int) -> None:
+        nonlocal occupancy_ok
+        occupancy_ok = occupancy_ok and engine.active_slots <= scfg.num_slots
+
+    with CompileWatcher() as watcher:
+        watcher.mark_steady()
+        report = run_load(
+            engine,
+            TrafficConfig(requests=SERVE_REQUESTS, seed=0),
+            clock,
+            on_step=watch_occupancy,
+        )
+        retraces = watcher.new_compiles_since_mark()
+    snap = engine.snapshot()
+    if report.completed != SERVE_REQUESTS:
+        failures.append(
+            f"serve scheduler lost requests: {report.completed}/{SERVE_REQUESTS}"
+        )
+    if not occupancy_ok:
+        failures.append(
+            f"serve scheduler overfilled its {scfg.num_slots} slots"
+        )
+    if snap["max_wait_steps"] > SERVE_STARVATION_BOUND:
+        failures.append(
+            f"serve scheduler starved a request for {snap['max_wait_steps']} "
+            f"steps (bound {SERVE_STARVATION_BOUND})"
+        )
+    if retraces:
+        failures.append(
+            f"serve decode retraced after warmup: {sorted(retraces)}"
+        )
+    return {
+        "requests": SERVE_REQUESTS,
+        "completed": report.completed,
+        "steps": report.steps,
+        "max_wait_steps": snap["max_wait_steps"],
+        "recycled_blocks": snap["recycled_blocks"],
+        "post_warmup_compiles": len(retraces),
+    }, failures
+
+
 def main() -> int:
     u8_snap, u8_x = run_pipeline("uint8")
     f32_snap, f32_x = run_pipeline("float32")
@@ -200,6 +285,9 @@ def main() -> int:
         if phase not in snap["phases"]:
             failures.append(f"profiler snapshot missing phase {phase!r}")
 
+    serve_snap, serve_failures = serve_scheduler()
+    failures.extend(serve_failures)
+
     if failures:
         for f in failures:
             print(f"perf-smoke: {f}", file=sys.stderr)
@@ -218,6 +306,7 @@ def main() -> int:
                     for k in ("bare_s", "profiled_s", "overhead_fraction")
                 },
                 "step_ms": snap["step_ms"],
+                "serve": serve_snap,
             },
             allow_nan=False,
         )

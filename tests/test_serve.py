@@ -1,0 +1,360 @@
+"""Serving plane (serve/): paged cache, continuous batching, parity, soak.
+
+The two heavyweight guarantees pinned here:
+
+- **Bit parity**: the slot-written paged decode path produces tokens
+  byte-identical to ``llama_decode.generate``'s whole-generation
+  ``lax.scan`` path for dense configs (greedy, same weights) — including
+  when requests are admitted mid-flight into an active batch.
+- **One compile**: a soak of 200+ mixed-length requests through one
+  ``ServeReplica`` triggers exactly one compile of the decode step, at
+  warmup, and none after (the DLC410 property, observed live).
+
+Everything runs on the conftest's 8 virtual CPU devices and virtual
+clocks; wall time is compile time only.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_cfn_tpu.analysis.compile_audit import CompileWatcher
+from deeplearning_cfn_tpu.analysis.schedules import VirtualClock
+from deeplearning_cfn_tpu.models import llama
+from deeplearning_cfn_tpu.models.llama_decode import generate
+from deeplearning_cfn_tpu.serve import (
+    BlockAllocator,
+    ContinuousBatchingEngine,
+    ServeAdmissionError,
+    ServeConfig,
+    ServeFrontEnd,
+    ServeReplica,
+    ServeRequest,
+    TrafficConfig,
+    init_paged_cache,
+    plan_placement,
+    run_load,
+)
+
+CFG = dataclasses.replace(
+    llama.LlamaConfig.tiny(vocab_size=64, seq_len=64), dtype=jnp.float32
+)
+SCFG = ServeConfig(num_slots=4, block_size=4, blocks_per_slot=8, prefill_len=16)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.key(0))
+
+
+def make_engine(params, scfg=SCFG, clock=None, **kw):
+    return ContinuousBatchingEngine(
+        CFG, params, scfg, clock=clock or (lambda: 0.0), journal=False, **kw
+    )
+
+
+def drain(engine_or_frontend):
+    step = getattr(engine_or_frontend, "step_all", None) or engine_or_frontend.step
+    out = {}
+    while engine_or_frontend.pending():
+        for c in step():
+            out[c.request_id] = c
+    return out
+
+
+# --- block allocator ---------------------------------------------------------
+
+
+def test_allocator_is_all_or_nothing_and_lowest_first():
+    alloc = BlockAllocator(8)
+    assert alloc.allocate(3) == [0, 1, 2]
+    assert alloc.allocate(6) is None  # only 5 left: nothing handed out
+    assert alloc.free_blocks == 5
+    assert alloc.allocate(5) == [3, 4, 5, 6, 7]
+
+
+def test_allocator_recycles_deterministically():
+    alloc = BlockAllocator(8)
+    a = alloc.allocate(4)
+    b = alloc.allocate(4)
+    alloc.free(a)
+    assert alloc.recycled == 4
+    # Freed pages come back lowest-id-first: same admission order, same
+    # physical placement, every run.
+    assert alloc.allocate(2) == [0, 1]
+    alloc.free(b)
+    assert alloc.allocate(3) == [2, 3, 4]
+
+
+def test_allocator_rejects_double_free_and_bad_ids():
+    alloc = BlockAllocator(4)
+    blocks = alloc.allocate(2)
+    alloc.free(blocks)
+    with pytest.raises(ValueError, match="double free"):
+        alloc.free([blocks[0]])
+    with pytest.raises(ValueError, match="outside pool"):
+        alloc.free([99])
+
+
+def test_paged_cache_pool_shape():
+    cache = init_paged_cache(CFG, num_blocks=6, block_size=4)
+    assert cache.k.shape == (CFG.n_layers, 6, 4, CFG.n_kv_heads, CFG.head_dim)
+    assert cache.num_blocks == 6 and cache.block_size == 4
+
+
+# --- admission ---------------------------------------------------------------
+
+
+def test_admission_rejects_unservable_requests(params):
+    engine = make_engine(params)
+    with pytest.raises(ServeAdmissionError, match="prefill_len"):
+        engine.submit(ServeRequest("a", np.arange(17, dtype=np.int32), 1))
+    with pytest.raises(ServeAdmissionError, match="max context"):
+        engine.submit(ServeRequest("b", np.arange(16, dtype=np.int32), 18))
+    with pytest.raises(ServeAdmissionError, match="max_new_tokens"):
+        engine.submit(ServeRequest("c", np.arange(4, dtype=np.int32), 0))
+    with pytest.raises(ServeAdmissionError, match="non-empty"):
+        engine.submit(ServeRequest("d", np.zeros(0, np.int32), 2))
+    assert engine.queue_depth == 0  # nothing half-accepted
+
+
+def test_admission_backpressure_bounds_the_queue(params):
+    scfg = dataclasses.replace(SCFG, max_queue=2)
+    engine = make_engine(params, scfg)
+    engine.submit(ServeRequest("a", np.arange(4, dtype=np.int32), 2))
+    engine.submit(ServeRequest("b", np.arange(4, dtype=np.int32), 2))
+    with pytest.raises(ServeAdmissionError, match="queue full"):
+        engine.submit(ServeRequest("c", np.arange(4, dtype=np.int32), 2))
+    assert engine.rejected == 1
+
+
+# --- parity ------------------------------------------------------------------
+
+
+def parity_setup(params):
+    # max_context (block_size * blocks_per_slot = 16) equals generate's
+    # max_seq (prompt 8 + 8 new), so both paths reduce attention over
+    # identical extents — the condition for bit parity, not just closeness.
+    scfg = ServeConfig(
+        num_slots=2, block_size=4, blocks_per_slot=4, prefill_len=8
+    )
+    prompts = np.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(2, 8)), np.int32
+    )
+    ref = np.asarray(
+        generate(
+            CFG,
+            params,
+            jnp.asarray(prompts),
+            jax.random.key(1),
+            max_new_tokens=8,
+            temperature=0.0,
+        )
+    )
+    return scfg, prompts, ref
+
+
+def test_paged_decode_bit_identical_to_generate(params):
+    """Satellite: slot-written paged cache == whole-generation lax.scan
+    path, exact to the bit (greedy, dense config)."""
+    scfg, prompts, ref = parity_setup(params)
+    engine = make_engine(params, scfg)
+    engine.submit(ServeRequest("r0", prompts[0], 8))
+    engine.submit(ServeRequest("r1", prompts[1], 8))
+    done = drain(engine)
+    got = np.stack([done["r0"].tokens, done["r1"].tokens])
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_parity_survives_mid_flight_admission(params):
+    """The second request joins an in-flight decode batch (continuous
+    batching) and still matches the undisturbed reference bitwise."""
+    scfg, prompts, ref = parity_setup(params)
+    engine = make_engine(params, scfg)
+    engine.submit(ServeRequest("r0", prompts[0], 8))
+    done = {}
+    for i in range(64):
+        if i == 3:
+            engine.submit(ServeRequest("r1", prompts[1], 8))
+        for c in engine.step():
+            done[c.request_id] = c
+        if i >= 3 and not engine.pending():
+            break
+    got = np.stack([done["r0"].tokens, done["r1"].tokens])
+    np.testing.assert_array_equal(got, ref)
+
+
+# --- the soak ----------------------------------------------------------------
+
+
+def test_soak_200_requests_one_decode_compile(params):
+    """Acceptance: >= 200 mixed-length requests through one ServeReplica
+    with exactly one compile of the decode step — at warmup — and zero
+    compiles of anything after steady-state is marked."""
+    scfg = ServeConfig(
+        num_slots=8, block_size=4, blocks_per_slot=8, prefill_len=16
+    )
+    clock = VirtualClock()
+    replica = ServeReplica(
+        make_engine(params, scfg, clock=clock), "soak0"
+    )
+    with CompileWatcher() as watcher:
+        # Warmup: the first request compiles prefill + decode.
+        replica.submit(ServeRequest("warm", np.array([1, 2, 3], np.int32), 4))
+        drain(replica)
+        decode_compiles = {
+            name: n
+            for name, n in watcher.compiles.items()
+            if "paged_decode_step" in name
+        }
+        assert sum(decode_compiles.values()) == 1, decode_compiles
+        watcher.mark_steady()
+        report = run_load(
+            replica,
+            TrafficConfig(
+                requests=200,
+                seed=0,
+                prompt_len_range=(1, 16),
+                output_len_range=(1, 16),
+            ),
+            clock,
+        )
+        assert watcher.new_compiles_since_mark() == {}
+    assert report.completed == 200
+    snap = replica.engine.snapshot()
+    assert snap["free_blocks"] == scfg.resolved_num_blocks  # all pages recycled
+    assert snap["recycled_blocks"] > 0
+
+
+def test_loadgen_is_deterministic_per_seed(params):
+    tcfg = TrafficConfig(requests=40, seed=3)
+    clock_a, clock_b = VirtualClock(), VirtualClock()
+    a = run_load(make_engine(params, clock=clock_a), tcfg, clock_a)
+    b = run_load(make_engine(params, clock=clock_b), tcfg, clock_b)
+    assert a.to_dict() == b.to_dict()
+    assert a.completions == b.completions
+    # Different seed, different traffic (the seed is live, not decor).
+    clock_c = VirtualClock()
+    c = run_load(
+        make_engine(params, clock=clock_c),
+        TrafficConfig(requests=40, seed=4),
+        clock_c,
+    )
+    assert c.completions != a.completions
+
+
+# --- front-end failover ------------------------------------------------------
+
+
+def test_frontend_failover_loses_nothing_and_outputs_match(params):
+    tcfg = TrafficConfig(requests=50, seed=5)
+    ref_clock = VirtualClock()
+    reference = run_load(make_engine(params, clock=ref_clock), tcfg, ref_clock)
+
+    clock = VirtualClock()
+    frontend = ServeFrontEnd(
+        [
+            ServeReplica(make_engine(params, clock=clock), f"rep{i}")
+            for i in range(2)
+        ]
+    )
+    killed = []
+
+    def chaos(step):
+        if step == 20 and not killed:
+            killed.append(frontend.fail_replica("rep0"))
+
+    live = run_load(frontend, tcfg, clock, on_step=chaos)
+    assert live.completed == tcfg.requests
+    assert frontend.lost_requests() == []
+    assert frontend.failed == ["rep0"]
+    # Greedy determinism: failover is invisible in outputs.
+    assert live.completions == reference.completions
+
+
+def test_disaggregated_prefill_matches_colocated(params):
+    placement = plan_placement()
+    if not placement.disaggregated:
+        pytest.skip("needs >= 2 devices")
+    tcfg = TrafficConfig(requests=20, seed=6)
+    clock_a = VirtualClock()
+    colocated = run_load(make_engine(params, clock=clock_a), tcfg, clock_a)
+    clock_b = VirtualClock()
+    engine = make_engine(params, clock=clock_b, placement=placement)
+    disagg = run_load(engine, tcfg, clock_b)
+    assert disagg.completions == colocated.completions
+    assert engine.kv_transfer_bytes > 0  # the prefill K/V actually moved
+
+
+# --- metrics plumbing --------------------------------------------------------
+
+
+def test_exporter_folds_and_renders_serve_metrics():
+    from deeplearning_cfn_tpu.obs.exporter import (
+        fold_serve_events,
+        render_prometheus,
+    )
+
+    events = [
+        {"kind": "serve_metrics", "replica": "rep0", "active_slots": 1,
+         "queue_depth": 0, "tokens_per_s": 10.0, "admitted": 3,
+         "ttft_ms": {"p50": 5.0, "p99": 9.0}},
+        {"kind": "other", "replica": "nope"},
+        {"kind": "serve_metrics", "replica": "rep0", "active_slots": 2,
+         "queue_depth": 4, "tokens_per_s": 12.5, "admitted": 7,
+         "ttft_ms": {"p50": 6.0, "p99": 11.0}},
+    ]
+    folded = fold_serve_events(events)
+    assert folded["rep0"]["active_slots"] == 2  # last snapshot wins
+    text = render_prometheus(serve=folded, cluster="c1")
+    assert 'dlcfn_serve_active_slots{cluster="c1",replica="rep0"} 2' in text
+    assert 'dlcfn_serve_queue_depth{cluster="c1",replica="rep0"} 4' in text
+    assert 'dlcfn_serve_tokens_per_s{cluster="c1",replica="rep0"} 12.5' in text
+    assert (
+        'dlcfn_serve_ttft_ms{cluster="c1",replica="rep0",quantile="0.99"} 11.0'
+        in text
+    )
+    assert fold_serve_events([{"kind": "other"}]) == {}
+
+
+def test_cli_status_serve_block(tmp_path, capsys):
+    import json
+
+    from deeplearning_cfn_tpu.cli import main
+
+    journal = tmp_path / "journal.jsonl"
+    journal.write_text(
+        json.dumps(
+            {"ts": 1.0, "kind": "serve_metrics", "replica": "rep0",
+             "active_slots": 3, "queue_depth": 1, "tokens_per_s": 42.0,
+             "admitted": 9, "ttft_ms": {"p50": 4.0}}
+        )
+        + "\n"
+    )
+    assert main(["status", "--journal", str(journal), "--serve"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["serve"]["rep0"]["active_slots"] == 3
+    assert out["serve"]["rep0"]["tokens_per_s"] == 42.0
+
+
+def test_replica_registers_in_broker_kv(params):
+    replica = ServeReplica(make_engine(params), "rep0", group="g")
+
+    class KV:
+        def __init__(self):
+            self.table = {}
+
+        def set(self, key, value):
+            self.table[key] = value
+
+    kv = KV()
+    replica.register(kv)
+    assert "serve/g/rep0" in kv.table
+    import json
+
+    payload = json.loads(kv.table["serve/g/rep0"])
+    assert payload["num_slots"] == SCFG.num_slots
+    assert payload["max_context"] == SCFG.max_context
