@@ -353,3 +353,444 @@ class HeartbeatChoreography:
 
     def terminated_workers(self) -> list[str]:
         return [event.instance_id for event, _silence in self.terminated]
+
+
+# --- replicated control plane on virtual time --------------------------------
+
+
+class SimNotPrimary(SimBrokerError):
+    """Write rejected by a standby or deposed node ("ERR not primary")."""
+
+
+class SimFenced(SimBrokerError):
+    """Replication entry rejected by epoch fencing ("ERR fenced")."""
+
+
+class SimBrokerNode(SimBroker):
+    """One virtual broker process: :class:`SimBroker`'s heartbeat table
+    plus the replicated queue/KV state, a role, an epoch, and — while
+    primary — a journal of applied frames (the sim twin of the C++
+    broker's ``DLCFN_BROKER_REPL_LOG`` stream).  Mutations mirror the
+    wire contract: they raise :class:`SimNotPrimary` on a non-primary
+    and plain :class:`SimBrokerError` once the process is killed; reads
+    stay open on a live standby.
+
+    One deliberate divergence from the binary: replayed HEARTBEAT frames
+    carry the ORIGINAL beat timestamp instead of being restamped at
+    apply time.  The real pair restamps because two hosts' clocks are
+    not comparable; the sim shares one virtual clock, so carrying the
+    send instant keeps silence ground truth exact across a failover.
+    """
+
+    def __init__(
+        self,
+        clock: VirtualClock,
+        name: str = "broker-a",
+        role: str = "primary",
+        epoch: int = 0,
+    ):
+        super().__init__(clock)
+        self.name = name
+        self.role = role
+        self.epoch = epoch
+        self.up = True
+        self.journal: list[dict] = []  # [{"seq","epoch","ts","frame"}]
+        self.seq = 0  # last seq journaled as primary
+        self.sync_seq = 0  # last seq applied as standby
+        self.fenced = 0  # stale-epoch SYNC rejections
+        self.queues: dict[str, list[tuple[str, bytes]]] = {}
+        self.applied: dict[str, set[str]] = {}  # queue -> idempotency keys
+        self.kv: dict[str, bytes] = {}
+
+    # -- role / liveness gates -------------------------------------------
+    def _gate_write(self) -> None:
+        if not self.up:
+            raise SimBrokerError("closed connection")
+        if self.role != "primary":
+            raise SimNotPrimary("not primary")
+
+    def _journal_frame(self, frame: dict) -> None:
+        self.seq += 1
+        self.journal.append(
+            {
+                "seq": self.seq,
+                "epoch": self.epoch,
+                "ts": self._clock.now(),
+                "frame": frame,
+            }
+        )
+
+    # -- client verbs (mutating: primary only) ---------------------------
+    def record(self, worker: str) -> int:
+        self._gate_write()
+        count = super().record(worker)
+        self._journal_frame(
+            {
+                "verb": "HEARTBEAT",
+                "worker": worker,
+                "ts": self._beats[worker][0],
+                "count": count,
+            }
+        )
+        return count
+
+    def send_idempotent(self, queue: str, body: bytes, rid: str) -> str:
+        self._gate_write()
+        if self._apply_send(queue, body, rid):
+            # Journaled only when actually applied — a deduped re-send
+            # must not inflate the replication stream (matches the
+            # binary's applied-gated repl_append).
+            self._journal_frame(
+                {"verb": "SENDID", "queue": queue, "rid": rid, "body": body}
+            )
+        return rid
+
+    def set(self, key: str, value: bytes) -> None:
+        self._gate_write()
+        self.kv[key] = value
+        self._journal_frame({"verb": "SET", "key": key, "value": value})
+
+    # -- reads (open on any live node) -----------------------------------
+    def dump(self) -> dict[str, tuple[float, int]]:
+        if not self.up:
+            raise SimBrokerError("closed connection")
+        return super().dump()
+
+    def depth(self, queue: str) -> int:
+        if not self.up:
+            raise SimBrokerError("closed connection")
+        return len(self.queues.get(queue, ()))
+
+    # -- replication (standby side) --------------------------------------
+    def _apply_send(self, queue: str, body: bytes, rid: str) -> bool:
+        seen = self.applied.setdefault(queue, set())
+        if rid in seen:
+            return False
+        seen.add(rid)
+        self.queues.setdefault(queue, []).append((rid, body))
+        return True
+
+    def _apply_frame(self, frame: dict) -> None:
+        verb = frame["verb"]
+        if verb == "SENDID":
+            self._apply_send(frame["queue"], frame["body"], frame["rid"])
+        elif verb == "SET":
+            self.kv[frame["key"]] = frame["value"]
+        elif verb == "HEARTBEAT":
+            self._beats[frame["worker"]] = (frame["ts"], frame["count"])
+        else:
+            raise ValueError(f"unknown replication verb {verb!r}")
+
+    def sync(self, epoch: int, seq: int, frame: dict) -> int:
+        """Apply one replicated journal entry (the SYNC verb).  Epoch
+        fencing first: a stale term is rejected and counted; a HIGHER
+        term demotes this node if it thought itself primary (the deposed
+        half of a split brain learns it lost).  Then seq dedup: entries
+        at-or-below the applied watermark are skipped, so at-least-once
+        shipping never double-applies."""
+        if not self.up:
+            raise SimBrokerError("closed connection")
+        if epoch < self.epoch or (epoch == self.epoch and self.role == "primary"):
+            self.fenced += 1
+            raise SimFenced(
+                f"fenced: epoch {epoch} is stale at {self.name} "
+                f"(epoch {self.epoch}, role {self.role})"
+            )
+        if epoch > self.epoch:
+            self.epoch = epoch
+            self.role = "standby"
+        if seq > self.sync_seq:
+            self._apply_frame(frame)
+            self.sync_seq = seq
+        return seq
+
+    def promote(self, epoch: int) -> int:
+        """Fence to a strictly-higher epoch and take over as primary;
+        the journal seq resumes from the replication watermark so the
+        new term's entries extend (never collide with) the applied
+        history."""
+        if not self.up:
+            raise SimBrokerError("closed connection")
+        if epoch <= self.epoch:
+            raise SimBrokerError(
+                f"stale epoch {epoch} (current {self.epoch})"
+            )
+        self.epoch = epoch
+        self.role = "primary"
+        self.seq = max(self.seq, self.sync_seq)
+        return epoch
+
+
+class ReplicatedSimBroker:
+    """A primary + warm-standby broker pair on virtual time.
+
+    ``stream()`` plays :class:`ReplicationStreamer`: it ships journal
+    entries the standby has not applied (``max_entries`` models a
+    streamer that had not caught up when the primary died — the
+    unshipped tail is what a warm standby genuinely loses).
+    ``kill_primary()`` is the process dying; ``promote_standby()`` is
+    the ``_adopt_standby`` ladder (fence to ``max(epochs) + 1``).  For
+    split-brain schedules the primary is NOT killed: it keeps accepting
+    writes on its side of the partition, and its post-promotion
+    ``stream()`` attempts must all raise :class:`SimFenced` at the new
+    primary, with ``demote()`` modelling the deposed node standing down
+    once fenced."""
+
+    def __init__(self, clock: VirtualClock):
+        self.clock = clock
+        self.primary = SimBrokerNode(clock, "broker-a", role="primary")
+        self.standby = SimBrokerNode(clock, "broker-b", role="standby")
+
+    def nodes(self) -> list[SimBrokerNode]:
+        return [self.primary, self.standby]
+
+    def active(self) -> SimBrokerNode | None:
+        """The live node currently claiming primary, if any."""
+        for node in self.nodes():
+            if node.up and node.role == "primary":
+                return node
+        return None
+
+    def active_dump(self) -> dict[str, tuple[float, int]]:
+        """The heartbeat table a liveness watcher would fetch: from the
+        live primary, or empty while no node serves (broker outage)."""
+        node = self.active()
+        return node.dump() if node is not None else {}
+
+    def pending(self, src: SimBrokerNode | None = None) -> list[dict]:
+        """Journal entries the standby has not applied, oldest first."""
+        src = src or self.primary
+        return [e for e in src.journal if e["seq"] > self.standby.sync_seq]
+
+    def stream(
+        self,
+        src: SimBrokerNode | None = None,
+        dst: SimBrokerNode | None = None,
+        max_entries: int | None = None,
+    ) -> int:
+        """Ship unapplied journal entries ``src`` -> ``dst``; returns the
+        count.  Raises :class:`SimFenced` the moment the receiver fences
+        the stream (a deposed primary learns about its deposition here)."""
+        src = src or self.primary
+        dst = dst or self.standby
+        if not src.up:
+            raise SimBrokerError(f"{src.name} is down")
+        todo = [e for e in src.journal if e["seq"] > dst.sync_seq]
+        if max_entries is not None:
+            todo = todo[:max_entries]
+        for entry in todo:
+            dst.sync(entry["epoch"], entry["seq"], entry["frame"])
+        return len(todo)
+
+    def kill_primary(self) -> None:
+        self.primary.up = False
+
+    def promote_standby(self) -> int:
+        epoch = max(self.primary.epoch, self.standby.epoch) + 1
+        return self.standby.promote(epoch)
+
+    def demote(self, node: SimBrokerNode) -> None:
+        """A fenced ex-primary stands down (what the real deposed broker
+        does on seeing a higher-epoch SYNC or BrokerFenced)."""
+        node.role = "standby"
+        node.epoch = max(n.epoch for n in self.nodes())
+
+
+class FailoverSimConnection:
+    """Duck-types the BrokerConnection surface agents use (heartbeat,
+    send_idempotent, close) with ``FailoverBrokerConnection``'s
+    walk-the-endpoint-list behavior: a dead node or a standby's
+    "not primary" rejection advances to the next endpoint; success on a
+    later endpoint IS the failover.  ``fail_when`` cuts this client off
+    from every endpoint (its side of a partition)."""
+
+    def __init__(
+        self,
+        nodes: Sequence[SimBrokerNode],
+        fail_when: Callable[[], bool] | None = None,
+    ):
+        self._nodes = list(nodes)
+        self._fail_when = fail_when
+        self.closed = False
+        self.failovers = 0
+
+    def _call(self, op: Callable[[SimBrokerNode], Any]) -> Any:
+        if self.closed:
+            raise SimBrokerError("connection is closed")
+        if self._fail_when is not None and self._fail_when():
+            raise SimBrokerError("network partition")
+        last: Exception | None = None
+        for i, node in enumerate(self._nodes):
+            try:
+                result = op(node)
+            except SimBrokerError as exc:
+                last = exc
+                continue
+            if i > 0:
+                self.failovers += 1
+            return result
+        raise SimBrokerError(f"no broker endpoint available: {last}")
+
+    def heartbeat(self, worker_id: str) -> int:
+        return self._call(lambda node: node.record(worker_id))
+
+    def send_idempotent(self, queue: str, body: bytes, rid: str) -> str:
+        return self._call(lambda node: node.send_idempotent(queue, body, rid))
+
+    def close(self) -> None:
+        self.closed = True
+
+
+def soak_failover(
+    agents: int = 1000,
+    seed: int = 0,
+    kill_count: int = 50,
+    senders: int = 100,
+    unshipped_tail: int = 37,
+    tick_s: float = 5.0,
+    config: LivenessConfig | None = None,
+) -> dict:
+    """1,000-agent (by default) broker-failover soak on virtual time.
+
+    Real ``Heartbeater`` instances beat through failover connections at a
+    :class:`ReplicatedSimBroker`; a real ``BrokerLivenessWatcher``
+    classifies silence from whichever node is primary.  A seeded subset
+    of agents dies silently; then the PRIMARY dies mid-round with
+    ``unshipped_tail`` journal entries never shipped; the standby is
+    promoted; traffic resumes through the failover path.  Meanwhile
+    ``senders`` agents each submit one idempotent request before the
+    kill and blindly RE-SEND the same request id after promotion (the
+    client cannot know whether its frame was replicated), so exactly-once
+    effects must come from idempotency keys honored by replay.
+
+    Returns structural facts only — no wall-clock, no paths — so chaos
+    reports and perf-smoke stages built on it are byte-deterministic per
+    seed:  ``lost_terminates`` / ``spurious_terminates`` /
+    ``duplicate_terminates`` / ``premature_terminates`` must all be 0,
+    ``duplicate_sends`` must be 0 with ``work_depth == senders``, and
+    ``fenced_writes`` stays 0 (no split brain in this scenario).
+    """
+    from deeplearning_cfn_tpu.cluster.broker_service import (
+        BrokerLivenessWatcher,
+    )
+    from deeplearning_cfn_tpu.obs.heartbeat import Heartbeater
+    from deeplearning_cfn_tpu.provision.events import EventBus, EventKind
+
+    rng = random.Random(seed)
+    clock = VirtualClock()
+    cluster = ReplicatedSimBroker(clock)
+    cfg = config or LivenessConfig()
+    bus = EventBus()
+    terminated: list[tuple[str, float | None]] = []
+
+    def on_event(event: Any) -> None:
+        if event.kind is EventKind.INSTANCE_TERMINATE:
+            node = cluster.active() or cluster.standby
+            terminated.append(
+                (event.instance_id, node.silence_s(event.instance_id))
+            )
+
+    bus.subscribe(on_event)
+    watcher = BrokerLivenessWatcher(
+        cluster_name="sim-failover",
+        group="agents",
+        bus=bus,
+        config=cfg,
+        clock=clock,
+        fetch=cluster.active_dump,
+    )
+
+    names = [f"agent-{i:04d}" for i in range(agents)]
+    killed = set(rng.sample(names, kill_count))
+    live = [w for w in names if w not in killed]
+    sender_names = rng.sample(live, senders)
+    beaters = {
+        w: Heartbeater(
+            host="sim",
+            port=0,
+            worker_id=w,
+            interval_s=tick_s,
+            connection_factory=lambda: FailoverSimConnection(cluster.nodes()),
+        )
+        for w in names
+    }
+    alive = set(names)
+
+    def round_(stream: bool = True) -> None:
+        for w in names:
+            if w in alive:
+                beaters[w].beat_step()
+        if stream and cluster.active() is cluster.primary:
+            cluster.stream()
+        clock.advance(tick_s)
+        watcher.poll()
+
+    # Warmup: everyone beating, replication caught up.
+    for _ in range(3):
+        round_()
+    # A seeded subset dies silently, mid-traffic.
+    alive -= killed
+    for _ in range(2):
+        round_()
+
+    # The kill round: beats + idempotent submissions land on the primary,
+    # which then dies with the journal tail unshipped.
+    for w in names:
+        if w in alive:
+            beaters[w].beat_step()
+    rids = {w: f"{w}/job-{seed}" for w in sender_names}
+    for w in sender_names:
+        cluster.primary.send_idempotent(
+            "work", f"payload-{w}".encode(), rids[w]
+        )
+    backlog = len(cluster.pending())
+    cluster.stream(max_entries=max(0, backlog - unshipped_tail))
+    lag_at_kill = len(cluster.pending())
+    cluster.kill_primary()
+    clock.advance(tick_s)
+    watcher.poll()  # broker outage: fetch is empty, nobody terminates early
+
+    # Promotion ladder: standby fenced to a strictly-higher epoch.
+    epoch = cluster.promote_standby()
+
+    # At-least-once across the switch: every sender blindly re-sends its
+    # request id through the failover path; replayed rids dedup, the
+    # unshipped tail lands exactly once.
+    resend = FailoverSimConnection(cluster.nodes())
+    for w in sender_names:
+        resend.send_idempotent("work", f"payload-{w}".encode(), rids[w])
+    resend.close()
+
+    # Drain: silence of the killed agents crosses dead_after_s on the NEW
+    # primary's replicated heartbeat table.
+    drain_rounds = int(cfg.dead_after_s // tick_s) + 3
+    for _ in range(drain_rounds):
+        round_(stream=False)
+
+    new_primary = cluster.standby
+    work = new_primary.queues.get("work", [])
+    rid_list = [rid for rid, _body in work]
+    term_names = [w for w, _s in terminated]
+    return {
+        "agents": agents,
+        "killed": len(killed),
+        "terminated": len(term_names),
+        "lost_terminates": len(killed - set(term_names)),
+        "spurious_terminates": len(set(term_names) - killed),
+        "duplicate_terminates": len(term_names) - len(set(term_names)),
+        "premature_terminates": sum(
+            1
+            for _w, s in terminated
+            if s is None or s < cfg.dead_after_s
+        ),
+        "senders": senders,
+        "work_depth": len(work),
+        "duplicate_sends": len(rid_list) - len(set(rid_list)),
+        "unshipped_at_kill": lag_at_kill,
+        "replayed_seq": new_primary.sync_seq,
+        "journaled_seq": cluster.primary.seq,
+        "epoch": epoch,
+        "fenced_writes": cluster.primary.fenced + cluster.standby.fenced,
+        "client_failovers": resend.failovers,
+        "rounds": 6 + drain_rounds,
+    }

@@ -23,6 +23,11 @@ Catalog:
   breaker (fail-fast, half-open probe, re-trip).
 * ``slow-disk``   — torn and slow checkpoint writes against the atomic
   write protocol and the local -> objectstore fallback chain.
+* ``broker-failover`` — the primary broker dies under 1,000 heartbeating
+  agents; the warm standby is promoted with zero lost INSTANCE_TERMINATE
+  events and zero duplicate side effects (idempotent replay + re-send).
+* ``split-brain``  — a partition isolates the primary; epoch fencing
+  rejects every stale-leader write and the deposed node stands down.
 * ``slice-loss-live`` — a whole slice dies mid-run under a REAL 2-slice
   SPMD trainer (8 virtual CPU devices): the debounced terminate burst
   must trigger exactly one live reshard onto the survivors with zero
@@ -1299,6 +1304,194 @@ def serve_replica_loss(seed: int) -> ScenarioReport:
     return report
 
 
+# --- broker-failover ---------------------------------------------------------
+
+
+def broker_failover(seed: int) -> ScenarioReport:
+    """The primary broker dies mid-traffic under 1,000 heartbeating
+    agents; the warm standby is promoted and NOTHING is lost.
+
+    Runs :func:`soak_failover` — real Heartbeaters and a real
+    BrokerLivenessWatcher over the replicated sim pair on virtual time —
+    and pins the acceptance invariants: every silently-killed agent is
+    terminated exactly once (zero lost, zero spurious, zero premature
+    INSTANCE_TERMINATE events), idempotent re-sends across the switch
+    produce zero duplicate side effects, and the promotion fenced a
+    strictly-higher epoch with no fenced writes (no split brain here).
+    """
+    from deeplearning_cfn_tpu.analysis.schedules import soak_failover
+
+    report = ScenarioReport("broker-failover", seed)
+    soak = soak_failover(agents=1000, seed=seed)
+    report.check(
+        soak["terminated"] == soak["killed"]
+        and soak["lost_terminates"] == 0,
+        "zero lost INSTANCE_TERMINATE events across the failover "
+        f"({soak['killed']} killed agents all terminated)",
+    )
+    report.check(
+        soak["spurious_terminates"] == 0,
+        "no live agent was spuriously terminated during the broker outage",
+    )
+    report.check(
+        soak["duplicate_terminates"] == 0,
+        "each killed agent terminated exactly once (no duplicates)",
+    )
+    report.check(
+        soak["premature_terminates"] == 0,
+        "every termination happened at silence >= dead_after_s "
+        "(ground truth from the replicated heartbeat table)",
+    )
+    report.check(
+        soak["duplicate_sends"] == 0
+        and soak["work_depth"] == soak["senders"],
+        "idempotent re-sends across the switch: every request id landed "
+        "exactly once (replayed or re-sent, never both)",
+    )
+    report.check(
+        soak["epoch"] == 1 and soak["fenced_writes"] == 0,
+        "standby promoted to a strictly-higher epoch; no write was fenced "
+        "(single leader throughout)",
+    )
+    report.check(
+        soak["unshipped_at_kill"] > 0
+        and soak["replayed_seq"] == soak["journaled_seq"] - soak["unshipped_at_kill"],
+        "the kill left a real unshipped journal tail and the standby "
+        "replayed exactly the shipped prefix",
+    )
+    report.check(
+        soak["client_failovers"] == soak["senders"],
+        "every re-sending client failed over past the dead primary",
+    )
+    report.details.update(soak)
+    return report
+
+
+# --- split-brain -------------------------------------------------------------
+
+
+def split_brain(seed: int) -> ScenarioReport:
+    """A partition isolates the primary; the standby is promoted; the
+    deposed primary keeps accepting writes on its side.  Epoch fencing
+    must reject every one of its stale replication entries, the deposed
+    node must stand down on contact with the higher epoch, and healed
+    clients' re-sends must land exactly once on the true primary."""
+    import random as _random
+
+    from deeplearning_cfn_tpu.analysis.schedules import (
+        FailoverSimConnection,
+        ReplicatedSimBroker,
+        SimFenced,
+        SimNotPrimary,
+        VirtualClock,
+    )
+
+    report = ScenarioReport("split-brain", seed)
+    rng = _random.Random(seed)
+    clock = VirtualClock()
+    cluster = ReplicatedSimBroker(clock)
+
+    # Healthy traffic, fully replicated, before the partition.
+    pre = 20
+    for i in range(pre):
+        cluster.primary.send_idempotent("work", f"pre-{i}".encode(), f"pre-{i}")
+        clock.advance(0.5)
+    cluster.stream()
+    report.check(
+        cluster.standby.sync_seq == cluster.primary.seq == pre,
+        "standby fully caught up before the partition",
+    )
+
+    # The operator side can't reach the primary and promotes the standby.
+    epoch = cluster.promote_standby()
+    report.check(
+        epoch == 1 and cluster.standby.role == "primary",
+        "standby promoted to a strictly-higher epoch",
+    )
+
+    # Dual leader: the deposed primary still believes it leads and keeps
+    # accepting writes from clients on its side of the partition.
+    stale = [f"stale-{seed}-{i}" for i in range(7 + rng.randrange(5))]
+    for rid in stale:
+        cluster.primary.send_idempotent("work", rid.encode(), rid)
+        clock.advance(0.5)
+    report.check(
+        cluster.primary.role == "primary" and cluster.primary.epoch == 0,
+        "deposed primary still claims leadership at the stale epoch "
+        "(the dangerous window is real)",
+    )
+
+    # Its replication stream must be fenced entry by entry.
+    fenced_raises = 0
+    for entry in cluster.pending():
+        try:
+            cluster.standby.sync(entry["epoch"], entry["seq"], entry["frame"])
+        except SimFenced:
+            fenced_raises += 1
+    report.check(
+        fenced_raises == len(stale)
+        and cluster.standby.fenced == len(stale),
+        f"epoch fencing rejected every stale-primary write "
+        f"({len(stale)} of {len(stale)})",
+    )
+    true_rids = {rid for rid, _body in cluster.standby.queues.get("work", [])}
+    report.check(
+        not (set(stale) & true_rids) and len(true_rids) == pre,
+        "no stale write leaked into the promoted primary's state",
+    )
+
+    # First contact with the higher epoch demotes the deposed node (the
+    # receive-side half: a SYNC from the new term stands it down).
+    cluster.standby.set("leader", b"broker-b")
+    new_entry = cluster.standby.journal[-1]
+    cluster.primary.sync(
+        new_entry["epoch"], cluster.primary.seq + 1, new_entry["frame"]
+    )
+    report.check(
+        cluster.primary.role == "standby"
+        and cluster.primary.epoch == epoch,
+        "deposed primary demoted itself on first higher-epoch contact",
+    )
+    demoted_rejects = False
+    try:
+        cluster.primary.send_idempotent("work", b"late", "post-demote")
+    except SimNotPrimary:
+        demoted_rejects = True
+    report.check(
+        demoted_rejects, "demoted node rejects client writes (not primary)"
+    )
+
+    # Heal: clients from the wrong side re-send their request ids through
+    # the failover path — exactly-once effects on the true primary, even
+    # with a duplicate retry round.
+    conn = FailoverSimConnection(cluster.nodes())
+    for _round in range(2):
+        for rid in stale:
+            conn.send_idempotent("work", rid.encode(), rid)
+    conn.close()
+    work = cluster.standby.queues.get("work", [])
+    rid_list = [rid for rid, _body in work]
+    report.check(
+        len(rid_list) == len(set(rid_list))
+        and set(stale) <= set(rid_list)
+        and len(work) == pre + len(stale),
+        "healed re-sends landed exactly once on the true primary",
+    )
+    report.check(
+        conn.failovers == 2 * len(stale),
+        "every healed send failed over past the demoted node",
+    )
+    report.details.update(
+        pre_partition_writes=pre,
+        stale_writes=len(stale),
+        fenced=cluster.standby.fenced,
+        epoch=epoch,
+        true_primary_depth=len(work),
+        demoted_epoch=cluster.primary.epoch,
+    )
+    return report
+
+
 SCENARIOS: dict[str, Callable[[int], ScenarioReport]] = {
     "silent-death": silent_death,
     "partition": partition,
@@ -1307,6 +1500,8 @@ SCENARIOS: dict[str, Callable[[int], ScenarioReport]] = {
     "slice-loss-live": slice_loss_live,
     "straggler": straggler,
     "serve-replica-loss": serve_replica_loss,
+    "broker-failover": broker_failover,
+    "split-brain": split_brain,
 }
 
 

@@ -577,6 +577,54 @@ def _status_liveness(args) -> dict | None:
     return cluster_liveness(args.cluster, config=config)
 
 
+def _status_broker_role(args) -> dict | None:
+    """Control-plane role / epoch / replication lag, or None.
+
+    ``--cluster`` reads the recorded replicated pair (primary plus warm
+    standby, with lag in entries and seconds); ``--broker HOST:PORT``
+    asks the dialed node directly via the ROLE verb.  A cluster with no
+    recorded broker, or a dial failure, yields None — status stays
+    usable against legacy single-process brokers."""
+    if args.cluster:
+        from deeplearning_cfn_tpu.cluster.broker_service import (
+            broker_replication_status,
+            broker_status,
+        )
+
+        if broker_status(args.cluster) is None:
+            return None
+        return broker_replication_status(args.cluster)
+    if args.status_broker:
+        from deeplearning_cfn_tpu.cluster.broker_client import (
+            BrokerConnection,
+            BrokerError,
+        )
+
+        host, port = _parse_broker(args.status_broker)
+        try:
+            conn = BrokerConnection(host, port)
+            try:
+                role_name, epoch, seq = conn.role()
+            finally:
+                conn.close()
+        except (OSError, BrokerError):
+            return None
+        return {
+            "primary": {
+                "host": host,
+                "port": port,
+                "alive": True,
+                "role": role_name,
+                "epoch": epoch,
+                "seq": seq,
+            },
+            "standby": None,
+            "lag_entries": None,
+            "lag_seconds": None,
+        }
+    return None
+
+
 def _status_spans(args) -> dict | None:
     """Span aggregates folded from a flight journal, or None.
 
@@ -769,7 +817,8 @@ def _status_metrics(base: str) -> list | None:
 def cmd_status(args) -> int:
     """Cluster status from any of three sources (at least one required):
     per-worker training metrics (--metrics-dir), broker-driven liveness
-    (--cluster / --broker), span aggregates from a flight journal
+    plus control-plane role/epoch/replication lag (--cluster / --broker),
+    span aggregates from a flight journal
     (--journal).  ``--format prom`` renders liveness + spans in Prometheus
     text exposition for a textfile collector."""
     if not (args.metrics_dir or args.cluster or args.status_broker or args.journal):
@@ -778,6 +827,7 @@ def cmd_status(args) -> int:
             "--broker, and/or --journal"
         )
     liveness = _status_liveness(args)
+    broker = _status_broker_role(args)
     spans = _status_spans(args)
     pipeline = _status_pipeline(args)
     reshard = _status_reshard(args)
@@ -801,12 +851,14 @@ def cmd_status(args) -> int:
                 mesh=mesh,
                 profile=profile,
                 serve=serve,
+                broker=broker,
             ),
             end="",
         )
         return 0
     if (
         liveness is None
+        and broker is None
         and spans is None
         and pipeline is None
         and mesh is None
@@ -820,6 +872,8 @@ def cmd_status(args) -> int:
     out: dict = {}
     if liveness is not None:
         out["liveness"] = liveness
+    if broker is not None:
+        out["broker"] = broker
     if mesh is not None:
         out["mesh"] = mesh
     if reshard is not None:
@@ -1385,11 +1439,13 @@ def main(argv: list[str] | None = None) -> int:
                     help="the job's DLCFN_METRICS_DIR (shared mount)")
     ps.add_argument("--cluster", default=None,
                     help="cluster name: per-worker liveness from its "
-                         "recorded broker's HEARTBEAT table")
+                         "recorded broker's HEARTBEAT table, plus the "
+                         "replicated pair's role/epoch/replication lag")
     ps.add_argument("--broker", default=None, dest="status_broker",
                     metavar="HOST:PORT",
                     help="dial a broker directly for the liveness table "
-                         "(AUTH token from $DLCFN_BROKER_TOKEN)")
+                         "and its ROLE (role/epoch/applied-seq); AUTH "
+                         "token from $DLCFN_BROKER_TOKEN")
     ps.add_argument("--journal", default=None,
                     help="flight journal (JSONL) to fold span aggregates from")
     ps.add_argument("--suspect-after", type=float, default=15.0,
@@ -1470,7 +1526,8 @@ def main(argv: list[str] | None = None) -> int:
     px.add_argument("--scenario", default=None,
                     help="scenario name (see --list): silent-death, "
                          "partition, flaky-rpc, slow-disk, slice-loss-live, "
-                         "straggler, serve-replica-loss")
+                         "straggler, serve-replica-loss, broker-failover, "
+                         "split-brain")
     px.add_argument("--seed", type=int, default=0,
                     help="fault-schedule seed; reports are deterministic "
                          "per (scenario, seed)")

@@ -15,13 +15,18 @@ import json
 import shutil
 import socket
 import subprocess
+import uuid
 from pathlib import Path
 from typing import Any
 
 from deeplearning_cfn_tpu.cluster.queue import Message, RendezvousQueue
 from deeplearning_cfn_tpu.obs.tracing import span
 from deeplearning_cfn_tpu.utils.logging import get_logger
-from deeplearning_cfn_tpu.utils.resilience import RetryExhausted, RetryPolicy
+from deeplearning_cfn_tpu.utils.resilience import (
+    CircuitBreaker,
+    RetryExhausted,
+    RetryPolicy,
+)
 from deeplearning_cfn_tpu.utils.timeouts import (
     BudgetExhausted,
     Clock,
@@ -52,6 +57,18 @@ BROKER_BIN = BROKER_DIR / "dlcfn-broker"
 
 class BrokerError(RuntimeError):
     pass
+
+
+class BrokerFenced(BrokerError):
+    """A replication write was rejected by epoch fencing: the sender is a
+    deposed primary and must stop streaming (docs/RESILIENCE.md)."""
+
+    def __init__(self, epoch: int, seq: int):
+        super().__init__(
+            f"replication fenced: epoch {epoch} is stale (entry seq {seq})"
+        )
+        self.epoch = epoch
+        self.seq = seq
 
 
 class BrokerTimeout(BrokerError, TimeoutError):
@@ -197,7 +214,14 @@ class BrokerConnection:
     @_traced
     def delete(self, queue: str, receipt: str) -> bool:
         self.sock.sendall(f"DEL {queue} {receipt}\n".encode())
-        return self._read_line() == "OK"
+        resp = self._read_line()
+        if resp == "OK":
+            return True
+        if resp == "MISS":
+            return False
+        # A standby's "ERR not primary" must surface as an error the
+        # failover wrapper can classify, not as a silent MISS.
+        raise BrokerError(f"DEL failed: {resp}")
 
     @_traced
     def depth(self, queue: str) -> int:
@@ -210,15 +234,17 @@ class BrokerConnection:
     @_traced
     def purge(self, queue: str) -> None:
         self.sock.sendall(f"PURGE {queue}\n".encode())
-        if self._read_line() != "OK":
-            raise BrokerError("PURGE failed")
+        resp = self._read_line()
+        if resp != "OK":
+            raise BrokerError(f"PURGE failed: {resp}")
 
     # --- shared KV (signals + group-state snapshots) ---------------------
     @_traced
     def set(self, key: str, value: bytes) -> None:
         self.sock.sendall(f"SET {key} {len(value)}\n".encode() + value)
-        if self._read_line() != "OK":
-            raise BrokerError("SET failed")
+        resp = self._read_line()
+        if resp != "OK":
+            raise BrokerError(f"SET failed: {resp}")
 
     @_traced
     def get(self, key: str) -> bytes | None:
@@ -233,7 +259,12 @@ class BrokerConnection:
     @_traced
     def unset(self, key: str) -> bool:
         self.sock.sendall(f"UNSET {key}\n".encode())
-        return self._read_line() == "OK"
+        resp = self._read_line()
+        if resp == "OK":
+            return True
+        if resp == "MISS":
+            return False
+        raise BrokerError(f"UNSET failed: {resp}")
 
     # --- liveness (obs plane) --------------------------------------------
     @_traced
@@ -262,6 +293,258 @@ class BrokerConnection:
             _, worker, age_ms, count = hline
             out[worker] = (int(age_ms) / 1000.0, int(count))
         return out
+
+    # --- replication / leader handover (docs/RESILIENCE.md) --------------
+    @_traced
+    def send_idempotent(self, queue: str, body: bytes, rid: str) -> str:
+        """Enqueue with an idempotency key: re-sending the same ``rid``
+        (the at-least-once re-send after a failover) enqueues at most
+        once — the rid doubles as the message id."""
+        if not rid or any(c.isspace() for c in rid):
+            raise BrokerError(f"bad idempotency key: {rid!r}")
+        self.sock.sendall(f"SENDID {queue} {rid} {len(body)}\n".encode() + body)
+        resp = self._read_line()
+        if not resp.startswith("OK "):
+            raise BrokerError(f"SENDID failed: {resp}")
+        return resp[3:]
+
+    @_traced
+    def role(self) -> tuple[str, int, int]:
+        """The peer's (role, epoch, replication position).  Position is
+        entries journaled for a primary, entries applied for a standby —
+        primary minus standby is the replication lag in entries."""
+        self.sock.sendall(b"ROLE\n")
+        rline = self._read_line().split(" ")
+        if rline[0] != "ROLE" or len(rline) != 4:
+            raise BrokerError(f"bad ROLE frame: {rline}")
+        _, role_name, epoch, seq = rline
+        return role_name, int(epoch), int(seq)
+
+    @_traced
+    def promote(self, epoch: int) -> int:
+        """Fence the peer to ``epoch`` and make it primary.  The epoch
+        must exceed the peer's current one (the promotion ladder)."""
+        self.sock.sendall(f"PROMOTE {epoch}\n".encode())
+        resp = self._read_line()
+        if not resp.startswith("OK "):
+            raise BrokerError(f"PROMOTE failed: {resp}")
+        return int(resp[3:])
+
+    @_traced
+    def sync_entry(self, epoch: int, seq: int, frame: bytes) -> int:
+        """Replicate one journal frame to a standby.  Raises
+        :class:`BrokerFenced` when the receiver's epoch is newer — this
+        sender has been deposed and must stop streaming."""
+        self.sock.sendall(f"SYNC {epoch} {seq} {len(frame)}\n".encode() + frame)
+        resp = self._read_line()
+        if resp.startswith("ERR fenced"):
+            raise BrokerFenced(epoch, seq)
+        if not resp.startswith("OK "):
+            raise BrokerError(f"SYNC failed: {resp}")
+        return int(resp[3:])
+
+
+def endpoints_from_record(record: dict) -> list[tuple[str, int]]:
+    """The failover endpoint list a broker record file publishes.
+
+    Replicated records carry ``endpoints`` (primary first, standby
+    after); legacy single-process records only have host/port."""
+    eps: list[tuple[str, int]] = []
+    for ep in record.get("endpoints") or []:
+        host, port = ep
+        eps.append((str(host), int(port)))
+    primary = (str(record["host"]), int(record["port"]))
+    if primary not in eps:
+        eps.insert(0, primary)
+    return eps
+
+
+class FailoverBrokerConnection:
+    """Broker client that fails over across replica endpoints.
+
+    Holds one live connection to the current leader.  A connection-level
+    failure (dial refused, peer died mid-RPC, a standby's ``ERR not
+    primary``) records a failure on THAT endpoint's breaker and moves to
+    the next endpoint whose breaker admits a call; endpoints whose
+    breaker is open are skipped (breaker-open is a failover trigger, not
+    a dead end).  The first successful RPC after a switch journals
+    ``broker_failover`` and resets the new endpoint's breaker — outage
+    classification stays endpoint-local, so a clean failover never counts
+    against a shared outage budget (docs/RESILIENCE.md "Broker
+    failover").
+
+    At-least-once safety: ``send`` goes through SENDID with a request id
+    generated once per logical send, so the re-send after a primary dies
+    mid-RPC (applied but unacked) cannot double-enqueue.  Every other
+    verb is idempotent (reads, last-write-wins KV, receipt-keyed acks) or
+    at-least-once by design (RECV leases).
+
+    ``dial(host, port)`` is the connection seam: tests and the
+    virtual-clock soak inject simulated connections; the default dials a
+    real :class:`BrokerConnection` with this instance's token.
+    """
+
+    _ENDPOINT_ERROR_HINTS = ("closed connection", "not primary")
+
+    def __init__(
+        self,
+        endpoints,
+        token: str | None = None,
+        dial=None,
+        breaker_factory=None,
+        clock: Clock | None = None,
+        max_cycles: int = 2,
+        timeout_s: float = 10.0,
+    ):
+        if not endpoints:
+            raise BrokerError("failover connection needs at least one endpoint")
+        self._endpoints = [(str(h), int(p)) for h, p in endpoints]
+        self._token = token
+        self._timeout_s = timeout_s
+        self._clock = clock or MonotonicClock()
+        if dial is None:
+
+            def dial(host: str, port: int):
+                return BrokerConnection(
+                    host, port, timeout_s=self._timeout_s, token=self._token
+                )
+
+        self._dial = dial
+        if breaker_factory is None:
+
+            def breaker_factory(host: str, port: int) -> CircuitBreaker:
+                return CircuitBreaker(
+                    name=f"broker-endpoint:{host}:{port}",
+                    failure_threshold=3,
+                    reset_after_s=5.0,
+                    clock=self._clock,
+                )
+
+        self._breakers = {ep: breaker_factory(*ep) for ep in self._endpoints}
+        self._conn = None
+        self._active = 0
+        self._established: tuple[str, int] | None = None
+        self._max_cycles = max_cycles
+        self.failovers = 0
+
+    @property
+    def active_endpoint(self) -> tuple[str, int]:
+        return self._endpoints[self._active]
+
+    def breaker(self, endpoint) -> CircuitBreaker:
+        host, port = endpoint
+        return self._breakers[(str(host), int(port))]
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+    def _is_endpoint_failure(self, exc: BaseException) -> bool:
+        if isinstance(exc, (ConnectionError, OSError)):
+            return True
+        if isinstance(exc, BrokerError):
+            text = str(exc)
+            return any(hint in text for hint in self._ENDPOINT_ERROR_HINTS)
+        return False
+
+    def _next_allowed(self) -> int | None:
+        n = len(self._endpoints)
+        for step in range(n):
+            idx = (self._active + step) % n
+            if self._breakers[self._endpoints[idx]].allow():
+                return idx
+        return None
+
+    def _call(self, rpc: str, op):
+        attempts = len(self._endpoints) * self._max_cycles
+        last: BaseException | None = None
+        for _ in range(attempts):
+            idx = self._next_allowed()
+            if idx is None:
+                break
+            endpoint = self._endpoints[idx]
+            try:
+                if self._conn is None or idx != self._active:
+                    self.close()
+                    self._conn = self._dial(*endpoint)
+                    self._active = idx
+                result = op(self._conn)
+            except BaseException as exc:
+                if not self._is_endpoint_failure(exc):
+                    raise
+                last = exc
+                self._breakers[endpoint].record_failure()
+                self.close()
+                self._active = (idx + 1) % len(self._endpoints)
+                continue
+            if self._established is not None and endpoint != self._established:
+                # A successful switch is a failover, not an outage: reset
+                # the adopted endpoint's breaker and journal the event
+                # instead of feeding any shared failure budget.
+                self.failovers += 1
+                from deeplearning_cfn_tpu.obs.recorder import get_recorder
+
+                get_recorder().record(
+                    "broker_failover",
+                    rpc=rpc,
+                    from_host=self._established[0],
+                    from_port=self._established[1],
+                    to_host=endpoint[0],
+                    to_port=endpoint[1],
+                )
+            self._breakers[endpoint].record_success()
+            self._established = endpoint
+            return result
+        raise BrokerError(
+            f"{rpc}: no broker endpoint available (endpoints "
+            f"{self._endpoints}, last error: {last})"
+        ) from last
+
+    # -- the BrokerConnection surface, failover-wrapped -------------------
+    def ping(self) -> bool:
+        return self._call("ping", lambda c: c.ping())
+
+    def send(self, queue: str, body: bytes, rid: str | None = None) -> str:
+        rid = rid or uuid.uuid4().hex
+        return self._call("send", lambda c: c.send_idempotent(queue, body, rid))
+
+    def send_idempotent(self, queue: str, body: bytes, rid: str) -> str:
+        return self._call(
+            "send_idempotent", lambda c: c.send_idempotent(queue, body, rid)
+        )
+
+    def receive(self, queue: str, max_messages: int, visibility_ms: int):
+        return self._call(
+            "receive", lambda c: c.receive(queue, max_messages, visibility_ms)
+        )
+
+    def delete(self, queue: str, receipt: str) -> bool:
+        return self._call("delete", lambda c: c.delete(queue, receipt))
+
+    def depth(self, queue: str) -> int:
+        return self._call("depth", lambda c: c.depth(queue))
+
+    def purge(self, queue: str) -> None:
+        return self._call("purge", lambda c: c.purge(queue))
+
+    def set(self, key: str, value: bytes) -> None:
+        return self._call("set", lambda c: c.set(key, value))
+
+    def get(self, key: str) -> bytes | None:
+        return self._call("get", lambda c: c.get(key))
+
+    def unset(self, key: str) -> bool:
+        return self._call("unset", lambda c: c.unset(key))
+
+    def heartbeat(self, worker_id: str) -> int:
+        return self._call("heartbeat", lambda c: c.heartbeat(worker_id))
+
+    def heartbeats(self) -> dict[str, tuple[float, int]]:
+        return self._call("heartbeats", lambda c: c.heartbeats())
+
+    def role(self) -> tuple[str, int, int]:
+        return self._call("role", lambda c: c.role())
 
 
 class BrokerQueue(RendezvousQueue):

@@ -53,7 +53,7 @@ from deeplearning_cfn_tpu.obs.liveness import (
     LivenessTable,
     WorkerState,
 )
-from deeplearning_cfn_tpu.obs.recorder import get_recorder
+from deeplearning_cfn_tpu.obs.recorder import get_recorder, read_journal
 from deeplearning_cfn_tpu.utils.logging import get_logger
 
 log = get_logger("dlcfn.broker")
@@ -64,6 +64,19 @@ _LISTENING = re.compile(r"listening on (\d+)")
 def _record_path(cluster_name: str, root: Path | None = None) -> Path:
     root = root or ClusterContract.root_dir()
     return root / "broker" / f"{cluster_name}.json"
+
+
+def _standby_record_path(cluster_name: str, root: Path | None = None) -> Path:
+    root = root or ClusterContract.root_dir()
+    return root / "broker" / f"{cluster_name}.standby.json"
+
+
+def _repl_log_path(cluster_name: str, root: Path | None = None) -> Path:
+    """The primary's replication journal: flight-recorder JSONL
+    (``kind: broker_apply``) appended by the broker binary for every
+    state mutation it applies, tailed by :class:`ReplicationStreamer`."""
+    root = root or ClusterContract.root_dir()
+    return root / "broker" / f"{cluster_name}.repl.jsonl"
 
 
 def detect_host_ip() -> str:
@@ -155,6 +168,98 @@ def broker_status(cluster_name: str, root: Path | None = None) -> dict | None:
     return data
 
 
+def standby_broker_status(
+    cluster_name: str, root: Path | None = None
+) -> dict | None:
+    """The recorded warm-standby replica for a cluster, plus liveness —
+    or None.  Loopback probe, same rationale as :func:`broker_status`."""
+    srec = _standby_record_path(cluster_name, root)
+    try:
+        data = json.loads(srec.read_text())
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+    data["alive"] = _alive("127.0.0.1", int(data["port"]))
+    return data
+
+
+def _adopt_standby(
+    cluster_name: str,
+    root: Path | None,
+    dead_record: dict,
+    rec: Path,
+) -> tuple[str, int, bool] | None:
+    """Promote a live warm standby over a dead primary's record.
+
+    The promotion ladder (docs/RESILIENCE.md "Broker failover"): fence the
+    standby to ``max(dead primary's epoch, standby's epoch) + 1`` with
+    PROMOTE — strictly above any term the deposed primary could still be
+    streaming under — then rewrite the PRIMARY record file to point at it
+    and unlink the standby record (it is a standby no more).  Returns
+    ``(host, port, False)`` like a reuse, or None when no LIVE standby
+    exists; a stale standby record is unlinked here so it cannot shadow
+    the dead primary (the single-process-singleton bug this replaces).
+    """
+    srec = _standby_record_path(cluster_name, root)
+    try:
+        standby = json.loads(srec.read_text())
+    except (OSError, ValueError):
+        return None
+    if not _alive("127.0.0.1", int(standby["port"])):
+        log.warning(
+            "standby broker record for %s (pid %s) is stale; removing it",
+            cluster_name, standby.get("pid"),
+        )
+        srec.unlink(missing_ok=True)
+        return None
+    token = standby.get("token") or dead_record.get("token") or ""
+    conn = BrokerConnection(
+        "127.0.0.1", int(standby["port"]), timeout_s=5.0, token=token
+    )
+    try:
+        _, standby_epoch, repl_seq = conn.role()
+        new_epoch = max(
+            int(dead_record.get("epoch", 0) or 0), standby_epoch
+        ) + 1
+        conn.promote(new_epoch)
+    finally:
+        conn.close()
+    host = standby.get("host") or dead_record.get("host") or "127.0.0.1"
+    port = int(standby["port"])
+    _write_record(
+        rec,
+        {
+            "cluster": cluster_name,
+            "host": host,
+            "port": port,
+            "pid": int(standby["pid"]),
+            "binds": standby.get("binds", dead_record.get("binds", "")),
+            "binds_requested": standby.get(
+                "binds_requested", dead_record.get("binds_requested", "")
+            ),
+            "token": token or None,
+            "role": "primary",
+            "epoch": new_epoch,
+            "endpoints": [[host, port]],
+            "started_ts": standby.get("started_ts", time.time()),
+        },
+    )
+    srec.unlink(missing_ok=True)
+    log.warning(
+        "promoted standby broker for %s at %s:%d (pid %s, epoch %d, "
+        "replayed seq %d)",
+        cluster_name, host, port, standby.get("pid"), new_epoch, repl_seq,
+    )
+    get_recorder().record(
+        "broker_promoted",
+        cluster=cluster_name,
+        broker_host=host,
+        broker_port=port,
+        epoch=new_epoch,
+        repl_seq=repl_seq,
+    )
+    return host, port, False
+
+
 def ensure_broker(
     cluster_name: str,
     root: Path | None = None,
@@ -163,6 +268,7 @@ def ensure_broker(
     timeout_s: float = 30.0,
     extra_binds: Sequence[str] | None = None,
     reuse_token: str | None = None,
+    reuse_epoch: int | None = None,
 ) -> tuple[str, int, bool]:
     """Return ``(host, port, started)`` for a live broker serving this
     cluster, starting one (detached) if none is recorded and reachable.
@@ -214,6 +320,10 @@ def ensure_broker(
                 cluster_name, host, advertise,
             )
             record["host"] = host = advertise
+            # The failover dial list leads with the primary's advertised
+            # address; keep it in step with the rewrite.
+            if record.get("endpoints"):
+                record["endpoints"][0] = [host, int(record["port"])]
             _write_record(
                 rec, {k: v for k, v in record.items() if k != "alive"}
             )
@@ -247,6 +357,10 @@ def ensure_broker(
             # and that CLI's process holds it ambiently — regenerating
             # would permanently lock them all out.
             reuse_token=old_record.get("token") or reuse_token,
+            # Bump the epoch: the replacement is a NEW leadership term (its
+            # in-memory state starts empty), so any stale replication
+            # stream from the torn-down broker must be fenced.
+            reuse_epoch=int(old_record.get("epoch", 0) or 0) + 1,
         )
 
     existing = broker_status(cluster_name, root)
@@ -256,6 +370,14 @@ def ensure_broker(
             if reused is None:
                 return restart_with_wider_binds(existing)
             return reused
+        # Dead primary: adopt (promote) a live warm standby before falling
+        # back to a cold start — a promotion keeps the replicated KV /
+        # queue / heartbeat state; a fresh spawn starts empty.  A STALE
+        # standby record is unlinked inside _adopt_standby so it can never
+        # shadow the dead primary on later calls.
+        adopted = _adopt_standby(cluster_name, root, existing, rec)
+        if adopted is not None:
+            return adopted
         log.warning(
             "recorded broker for %s at %s:%s is dead; starting a new one",
             cluster_name, existing["host"], existing["port"],
@@ -265,6 +387,11 @@ def ensure_broker(
         # the operator host must let them re-converge, not lock them out.
         if reuse_token is None:
             reuse_token = existing.get("token") or None
+        # Fence the dead broker's term even on a cold restart: if its
+        # process is merely partitioned (not dead) and later streams SYNC
+        # frames, the bumped epoch rejects them.
+        if reuse_epoch is None:
+            reuse_epoch = int(existing.get("epoch", 0) or 0) + 1
         rec.unlink(missing_ok=True)
 
     build_broker()
@@ -342,6 +469,7 @@ def ensure_broker(
                     cluster_name, root=root, advertise=advertise, port=port,
                     timeout_s=max(deadline - time.monotonic(), 5.0),
                     extra_binds=extra_binds, reuse_token=reuse_token,
+                    reuse_epoch=reuse_epoch,
                 )
             time.sleep(0.1)
         raise BrokerError(
@@ -369,12 +497,25 @@ def ensure_broker(
             import secrets
 
             token = reuse_token or secrets.token_hex(16)
+            epoch = int(reuse_epoch or 0)
+            # Fresh leadership term, fresh journal: a new primary's seq
+            # counter restarts at 1, so stale entries from the previous
+            # term would make a standby's skip-by-seq dedup swallow the
+            # new term's stream.
+            repl_log = _repl_log_path(cluster_name, root)
+            repl_log.unlink(missing_ok=True)
             proc = subprocess.Popen(
                 [str(BROKER_BIN), str(port), ",".join(bind_list)],
                 stdout=log_fh,
                 stderr=subprocess.STDOUT,
                 start_new_session=True,
-                env={**os.environ, "DLCFN_BROKER_TOKEN": token},
+                env={
+                    **os.environ,
+                    "DLCFN_BROKER_TOKEN": token,
+                    "DLCFN_BROKER_ROLE": "primary",
+                    "DLCFN_BROKER_EPOCH": str(epoch),
+                    "DLCFN_BROKER_REPL_LOG": str(repl_log),
+                },
             )
         finally:
             log_fh.close()
@@ -445,6 +586,14 @@ def ensure_broker(
                 "binds_requested": ",".join(requested),
                 # The AUTH shared secret; the record is chmod 0600.
                 "token": token,
+                # Replication metadata (docs/RESILIENCE.md "Broker
+                # failover"): the leadership term this process was fenced
+                # to at spawn, and the ordered dial list handed to
+                # failover clients (endpoints_from_record).  A standby
+                # attach (ensure_standby_broker) appends its address here.
+                "role": "primary",
+                "epoch": epoch,
+                "endpoints": [[host, bound_port]],
                 "started_ts": time.time(),
             },
         )
@@ -462,6 +611,295 @@ def ensure_broker(
         broker_pid=proc.pid,
     )
     return host, bound_port, True
+
+
+def ensure_standby_broker(
+    cluster_name: str,
+    root: Path | None = None,
+    port: int = 0,
+    timeout_s: float = 30.0,
+) -> tuple[str, int, bool]:
+    """Return ``(host, port, started)`` for a warm-standby replica of the
+    cluster's recorded primary, spawning one (detached) if none is live.
+
+    The standby runs on the same host as the primary (the operator /
+    controller host), shares its AUTH token, starts at the primary's
+    epoch with ``DLCFN_BROKER_ROLE=standby`` — rejecting client writes
+    until promoted — and is recorded in ``<cluster>.standby.json``.  The
+    PRIMARY record's ``endpoints`` list is extended so failover clients
+    (``FailoverBrokerConnection``) learn both addresses from one record.
+    State flows to it through :class:`ReplicationStreamer`, not at spawn:
+    a standby attached mid-life converges as the journal is replayed.
+    """
+    primary = broker_status(cluster_name, root)
+    if primary is None or not primary["alive"]:
+        raise BrokerError(
+            f"no live primary broker recorded for {cluster_name}; "
+            "run ensure_broker first"
+        )
+    srec = _standby_record_path(cluster_name, root)
+    existing = standby_broker_status(cluster_name, root)
+    if existing is not None:
+        if existing["alive"]:
+            log.info(
+                "reusing standby broker for %s at %s:%s (pid %s)",
+                cluster_name, existing["host"], existing["port"],
+                existing["pid"],
+            )
+            return existing["host"], int(existing["port"]), False
+        srec.unlink(missing_ok=True)
+
+    build_broker()
+    srec.parent.mkdir(parents=True, exist_ok=True)
+    log_path = srec.with_suffix(".log")
+    binds = str(
+        primary.get("binds_requested") or primary.get("binds") or "127.0.0.1"
+    )
+    token = primary.get("token") or ""
+    epoch = int(primary.get("epoch", 0) or 0)
+    # "wb" for the same stale-"listening on" reason as ensure_broker.
+    log_fh = open(log_path, "wb")
+    try:
+        proc = subprocess.Popen(
+            [str(BROKER_BIN), str(port), binds],
+            stdout=log_fh,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+            # Token via env (never argv); no DLCFN_BROKER_REPL_LOG — only
+            # the primary journals, a standby that journaled its replayed
+            # frames would re-ship them after its own promotion.
+            env={
+                **os.environ,
+                "DLCFN_BROKER_TOKEN": token,
+                "DLCFN_BROKER_ROLE": "standby",
+                "DLCFN_BROKER_EPOCH": str(epoch),
+            },
+        )
+    finally:
+        log_fh.close()
+
+    deadline = time.monotonic() + timeout_s
+    bound_port: int | None = None
+    while time.monotonic() < deadline and bound_port is None:
+        if proc.poll() is not None:
+            raise BrokerError(
+                f"standby broker exited with {proc.returncode} at startup; "
+                f"see {log_path}"
+            )
+        m = _LISTENING.search(log_path.read_text(errors="replace"))
+        if m:
+            bound_port = int(m.group(1))
+            break
+        time.sleep(0.05)
+    if bound_port is None:
+        proc.terminate()
+        raise BrokerError(
+            f"standby broker did not report a port; see {log_path}"
+        )
+    while time.monotonic() < deadline:
+        if _alive("127.0.0.1", bound_port):
+            break
+        time.sleep(0.05)
+    else:
+        proc.terminate()
+        raise BrokerError("standby broker did not become reachable")
+
+    host = primary["host"]
+    _write_record(
+        srec,
+        {
+            "cluster": cluster_name,
+            "host": host,
+            "port": bound_port,
+            "pid": proc.pid,
+            "binds": binds,
+            "binds_requested": binds,
+            "token": token or None,
+            "role": "standby",
+            "epoch": epoch,
+            "started_ts": time.time(),
+        },
+    )
+    prec = {k: v for k, v in primary.items() if k != "alive"}
+    prec["endpoints"] = [
+        [primary["host"], int(primary["port"])],
+        [host, bound_port],
+    ]
+    _write_record(_record_path(cluster_name, root), prec)
+    log.info(
+        "started standby broker for %s at %s:%d (pid %d, epoch %d, log %s)",
+        cluster_name, host, bound_port, proc.pid, epoch, log_path,
+    )
+    get_recorder().record(
+        "broker_standby_started",
+        cluster=cluster_name,
+        broker_host=host,
+        broker_port=bound_port,
+        broker_pid=proc.pid,
+        epoch=epoch,
+    )
+    return host, bound_port, True
+
+
+class ReplicationStreamer:
+    """Ships the primary's replication journal to the warm standby.
+
+    The primary appends every mutation it applies to a flight-recorder
+    JSONL journal (``kind: broker_apply``); this streamer tails the file
+    and replays each frame into the standby with SYNC.  Pull-based and
+    resumable: the streamer resumes from its last shipped seq, the
+    standby skips any entry at-or-below the seq it already applied
+    (crash-safe at-least-once shipping composes with idempotent replay),
+    and epoch fencing at the receiver raises ``BrokerFenced`` when this
+    stream belongs to a deposed primary — the split-brain guard.
+    """
+
+    def __init__(
+        self,
+        cluster_name: str,
+        root: Path | None = None,
+        connect=None,
+        clock=time.time,
+    ):
+        self.cluster_name = cluster_name
+        self._root = root
+        self._connect = connect  # injectable: () -> standby BrokerConnection
+        self._clock = clock
+        self.shipped_seq = 0
+        self.shipped_total = 0
+
+    def _dial_standby(self):
+        if self._connect is not None:
+            return self._connect()
+        standby = standby_broker_status(self.cluster_name, self._root)
+        if standby is None or not standby["alive"]:
+            raise BrokerError(
+                f"no live standby broker recorded for {self.cluster_name}"
+            )
+        return BrokerConnection(
+            "127.0.0.1",
+            int(standby["port"]),
+            timeout_s=5.0,
+            token=standby.get("token") or "",
+        )
+
+    def pending(self) -> list[dict]:
+        """Journal entries not yet shipped, oldest first."""
+        entries = read_journal(_repl_log_path(self.cluster_name, self._root))
+        return [
+            e
+            for e in entries
+            if e.get("kind") == "broker_apply"
+            and int(e.get("seq", 0)) > self.shipped_seq
+        ]
+
+    def lag_seconds(self) -> float:
+        """Age of the oldest journal entry not yet shipped; 0.0 when
+        caught up."""
+        todo = self.pending()
+        if not todo:
+            return 0.0
+        return max(0.0, self._clock() - float(todo[0].get("ts", 0.0)))
+
+    def step(self) -> int:
+        """Ship every unshipped journal entry to the standby; returns how
+        many were shipped.  Raises ``BrokerFenced`` (via sync_entry) when
+        the standby has seen a higher epoch — stop streaming, this
+        primary is deposed."""
+        todo = self.pending()
+        if not todo:
+            return 0
+        conn = self._dial_standby()
+        try:
+            for e in todo:
+                conn.sync_entry(
+                    int(e["epoch"]),
+                    int(e["seq"]),
+                    str(e["frame"]).encode("utf-8"),
+                )
+                self.shipped_seq = int(e["seq"])
+                self.shipped_total += 1
+        finally:
+            conn.close()
+        get_recorder().record(
+            "broker_replicate",
+            cluster=self.cluster_name,
+            shipped=len(todo),
+            seq=self.shipped_seq,
+            lag_s=round(
+                max(0.0, self._clock() - float(todo[-1].get("ts", 0.0))), 6
+            ),
+        )
+        return len(todo)
+
+
+def broker_replication_status(
+    cluster_name: str, root: Path | None = None, clock=time.time
+) -> dict | None:
+    """Role / epoch / applied-seq for the recorded primary and standby,
+    plus replication lag — the ``dlcfn status --broker`` and exporter
+    view.  None when no broker is recorded.  Lag is measured from the
+    journal: entries the standby has not applied, in count
+    (``lag_entries``) and age of the oldest such entry
+    (``lag_seconds``).  ``clock`` must match the journal's ``ts`` domain
+    (wall clock for the binary's log; a VirtualClock in sims) — lag is
+    an age metric against recorded stamps, not a deadline."""
+    primary = broker_status(cluster_name, root)
+    if primary is None:
+        return None
+
+    def probe(record: dict) -> dict:
+        out = {
+            "host": record["host"],
+            "port": int(record["port"]),
+            "pid": int(record["pid"]),
+            "alive": bool(record.get("alive")),
+            "role": record.get("role"),
+            "epoch": record.get("epoch"),
+            "seq": None,
+        }
+        if not out["alive"]:
+            return out
+        try:
+            conn = BrokerConnection(
+                "127.0.0.1",
+                out["port"],
+                timeout_s=2.0,
+                token=record.get("token") or "",
+            )
+            try:
+                role_name, epoch, seq = conn.role()
+            finally:
+                conn.close()
+            out.update(role=role_name, epoch=epoch, seq=seq)
+        except (OSError, BrokerError):
+            out["alive"] = False
+        return out
+
+    standby = standby_broker_status(cluster_name, root)
+    result = {
+        "primary": probe(primary),
+        "standby": probe(standby) if standby is not None else None,
+    }
+    pseq = result["primary"]["seq"]
+    sseq = (result["standby"] or {}).get("seq")
+    if pseq is None or sseq is None:
+        result["lag_entries"] = None
+        result["lag_seconds"] = None
+        return result
+    result["lag_entries"] = max(0, pseq - sseq)
+    lag_s = 0.0
+    if result["lag_entries"]:
+        entries = [
+            e
+            for e in read_journal(_repl_log_path(cluster_name, root))
+            if e.get("kind") == "broker_apply"
+            and int(e.get("seq", 0)) > sseq
+        ]
+        if entries:
+            lag_s = max(0.0, clock() - float(entries[0].get("ts", 0.0)))
+    result["lag_seconds"] = round(lag_s, 6)
+    return result
 
 
 class BrokerLivenessWatcher:
@@ -628,13 +1066,77 @@ def _unlink_lock_if_stale(lock: Path) -> None:
     stale.unlink(missing_ok=True)
 
 
+def _reap_standby(cluster_name: str, root: Path | None) -> dict | None:
+    """Stop and forget the cluster's recorded STANDBY broker, with the
+    same pid-identity discipline as the primary teardown (cmdline verify
+    on procfs; never signal an unverifiable pid).  None when no standby
+    record exists."""
+    srec = _standby_record_path(cluster_name, root)
+    status = standby_broker_status(cluster_name, root)
+    if status is None:
+        return None
+    pid = int(status["pid"])
+    verdict = "stopped"
+    if Path("/proc").exists():
+        try:
+            cmdline = (
+                Path(f"/proc/{pid}/cmdline").read_bytes().decode(errors="replace")
+            )
+        except OSError:
+            cmdline = ""
+        if "dlcfn-broker" not in cmdline:
+            verdict = "stale-record"
+    else:
+        verdict = "left-running"
+    if verdict == "stopped":
+
+        def gone() -> bool:
+            try:
+                if os.waitpid(pid, os.WNOHANG)[0] == pid:
+                    return True
+            except ChildProcessError:
+                pass
+            try:
+                os.kill(pid, 0)
+                return False
+            except ProcessLookupError:
+                return True
+
+        try:
+            os.kill(pid, signal.SIGTERM)
+            for _ in range(50):
+                if gone():
+                    break
+                time.sleep(0.1)
+            else:
+                os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        except PermissionError:
+            verdict = "left-running"
+    srec.unlink(missing_ok=True)
+    srec.with_suffix(".log").unlink(missing_ok=True)
+    return {
+        "broker": verdict,
+        "host": status["host"],
+        "port": status["port"],
+        "pid": pid,
+    }
+
+
 def teardown_broker(cluster_name: str, root: Path | None = None) -> dict:
-    """Stop and forget the cluster's recorded broker (``delete``'s side of
-    the stack-resource contract).  Safe when none exists."""
+    """Stop and forget the cluster's recorded broker — primary, warm
+    standby, and replication journal (``delete``'s side of the
+    stack-resource contract).  Safe when none exists."""
     rec = _record_path(cluster_name, root)
+    standby_result = _reap_standby(cluster_name, root)
+    _repl_log_path(cluster_name, root).unlink(missing_ok=True)
     status = broker_status(cluster_name, root)
     if status is None:
-        return {"broker": "none"}
+        result = {"broker": "none"}
+        if standby_result is not None:
+            result["standby"] = standby_result
+        return result
     pid = int(status["pid"])
 
     # Never SIGTERM a recycled pid: after a reboot the record survives but
@@ -659,12 +1161,15 @@ def teardown_broker(cluster_name: str, root: Path | None = None) -> dict:
         rec.unlink(missing_ok=True)
         rec.with_suffix(".log").unlink(missing_ok=True)
         _unlink_lock_if_stale(rec.with_suffix(".lock"))
-        return {
+        result = {
             "broker": verdict,
             "host": status["host"],
             "port": status["port"],
             "pid": pid,
         }
+        if standby_result is not None:
+            result["standby"] = standby_result
+        return result
 
     def gone() -> bool:
         # Reap first if the broker is OUR child (ensure_broker ran in this
@@ -711,5 +1216,7 @@ def teardown_broker(cluster_name: str, root: Path | None = None) -> dict:
         "port": status["port"],
         "pid": pid,
     }
+    if standby_result is not None:
+        result["standby"] = standby_result
     get_recorder().record("broker_teardown", cluster=cluster_name, **result)
     return result

@@ -51,6 +51,14 @@ BROKER_PROTOCOL_VERBS = (
     # HEARTBEAT <worker>                         record a liveness beat
     # HEARTBEAT                                  dump table: N <n> then HB lines
     "HEARTBEAT",
+    # -- replication / leader handover (docs/RESILIENCE.md "Broker
+    #    failover"): a warm standby replays the primary's journal and is
+    #    promoted with a higher epoch; epoch fencing rejects the deposed
+    #    primary's stale stream.
+    "SENDID",   # SENDID <queue> <rid> <nbytes>\n<body>  enqueue, idempotent by rid
+    "ROLE",     # ROLE                             report role, epoch, repl seq
+    "PROMOTE",  # PROMOTE <epoch>                  fence to epoch, become primary
+    "SYNC",     # SYNC <epoch> <seq> <nbytes>\n<entry>   replicate one journal frame
 )
 
 

@@ -97,6 +97,7 @@ def render_prometheus(
     mesh: Mapping[str, Any] | None = None,
     profile: Mapping[str, Any] | None = None,
     serve: Mapping[str, Mapping[str, Any]] | None = None,
+    broker: Mapping[str, Any] | None = None,
 ) -> str:
     """Render liveness snapshot + span aggregates + input-pipeline
     counters as Prometheus text.
@@ -110,7 +111,9 @@ def render_prometheus(
     shape from ``dlcfn status --cluster``; ``profile`` is the
     ``dlcfn status --profile`` dict (``{"profilers": {name: snapshot}}``)
     whose per-phase quantiles render as ``dlcfn_step_phase_ms``
-    summaries.  Any may be None/empty.
+    summaries; ``broker`` is
+    ``broker_service.broker_replication_status()`` (role/epoch per node
+    plus replication lag).  Any may be None/empty.
     """
     lines: list[str] = []
     if liveness:
@@ -328,5 +331,47 @@ def render_prometheus(
                 f"dlcfn_serve_ttft_ms_count"
                 f"{_labels(cluster=cluster, replica=replica)}"
                 f" {snap.get('admitted', 0)}"
+            )
+    if broker:
+        lines += [
+            "# HELP dlcfn_broker_role Broker role per node (1 = primary, 0 = standby).",
+            "# TYPE dlcfn_broker_role gauge",
+            "# HELP dlcfn_broker_epoch Leadership term the node is fenced to.",
+            "# TYPE dlcfn_broker_epoch gauge",
+            "# HELP dlcfn_broker_up 1 while the node answers on loopback.",
+            "# TYPE dlcfn_broker_up gauge",
+        ]
+        for node_name in ("primary", "standby"):
+            node = broker.get(node_name)
+            if not node:
+                continue
+            labels = _labels(
+                cluster=cluster,
+                node=node_name,
+                endpoint=f"{node.get('host')}:{node.get('port')}",
+            )
+            role = node.get("role")
+            lines.append(
+                f"dlcfn_broker_role{labels} {1 if role == 'primary' else 0}"
+            )
+            lines.append(f"dlcfn_broker_epoch{labels} {node.get('epoch') or 0}")
+            lines.append(f"dlcfn_broker_up{labels} {1 if node.get('alive') else 0}")
+        lag_s = broker.get("lag_seconds")
+        if lag_s is not None:
+            lines += [
+                "# HELP dlcfn_broker_replication_lag_seconds Age of the oldest journal entry the standby has not applied.",
+                "# TYPE dlcfn_broker_replication_lag_seconds gauge",
+            ]
+            lines.append(
+                f"dlcfn_broker_replication_lag_seconds{_labels(cluster=cluster)} {lag_s}"
+            )
+        lag_entries = broker.get("lag_entries")
+        if lag_entries is not None:
+            lines += [
+                "# HELP dlcfn_broker_replication_lag_entries Journal entries the standby has not applied.",
+                "# TYPE dlcfn_broker_replication_lag_entries gauge",
+            ]
+            lines.append(
+                f"dlcfn_broker_replication_lag_entries{_labels(cluster=cluster)} {lag_entries}"
             )
     return "\n".join(lines) + ("\n" if lines else "")

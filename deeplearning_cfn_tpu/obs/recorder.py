@@ -32,12 +32,35 @@ _json_safe: Callable[[Any], Any] | None = None
 
 
 def _safe(obj: Any) -> Any:
-    """train.metrics.json_safe, imported lazily (it pulls in jax)."""
+    """train.metrics.json_safe, imported lazily (it pulls in jax).
+
+    Journal events are almost always flat dicts of plain scalars, and
+    ``record()`` sits on the heartbeat/liveness hot path (a 1k-agent
+    soak journals tens of thousands of events), so flat plain-scalar
+    fields bypass the recursive sanitizer.  The type checks are exact:
+    numpy/jax scalars (``np.float64`` subclasses ``float``) and every
+    container still take the full ``json_safe`` walk.
+    """
     global _json_safe
     if _json_safe is None:
         from deeplearning_cfn_tpu.train.metrics import json_safe
 
         _json_safe = json_safe
+    if type(obj) is dict:
+        out = {}
+        for key, value in obj.items():
+            t = type(value)
+            if t is str or t is bool or t is int or value is None:
+                out[key] = value
+            elif t is float:
+                out[key] = (
+                    value
+                    if value == value and value not in (float("inf"), float("-inf"))
+                    else None
+                )
+            else:
+                out[key] = _json_safe(value)
+        return out
     return _json_safe(obj)
 
 

@@ -33,6 +33,21 @@
 //   HEARTBEAT <worker>\n                  -> OK <count>\n  (record a beat)
 //   HEARTBEAT\n                           -> N <n>\n then n x:
 //                                            HB <worker> <age_ms> <count>\n
+//   SENDID <queue> <rid> <len>\n<payload> -> OK <rid>\n   (idempotent by rid)
+//   ROLE\n                                -> ROLE <role> <epoch> <seq>\n
+//   PROMOTE <epoch>\n                     -> OK <epoch>\n | ERR stale epoch\n
+//   SYNC <epoch> <seq> <len>\n<entry>     -> OK <seq>\n | ERR fenced\n
+//
+// Replication (docs/RESILIENCE.md "Broker failover"): when
+// DLCFN_BROKER_REPL_LOG names a file, every applied mutation is appended
+// as one flight-recorder-style JSONL entry ({"ts", "kind":
+// "broker_apply", "seq", "epoch", "frame"}); a streamer tails that log
+// and replays each frame into a warm standby via SYNC.  DLCFN_BROKER_ROLE
+// ("primary" | "standby") and DLCFN_BROKER_EPOCH seed the handover state:
+// a standby rejects client mutations with ERR not primary, PROMOTE with a
+// higher epoch turns it into the new primary, and epoch fencing (SYNC
+// carrying an epoch below the receiver's) rejects a deposed primary's
+// stale stream so a partition cannot produce dual-leader writes.
 //
 // Heartbeats: the broker stores only last-beat timestamps and counts; the
 // ALIVE/SUSPECT/DEAD interpretation lives Python-side (obs/liveness.py)
@@ -87,6 +102,10 @@ struct Stored {
 
 struct Queue {
   std::map<std::string, Stored> messages;  // id -> message
+  // Idempotency keys already enqueued (SENDID + replication replay):
+  // kept after delete so an at-least-once re-send of an acked-then-acked
+  // message cannot re-appear.  Bounded by distinct control-plane rids.
+  std::set<std::string> applied;
 };
 
 struct Beat {
@@ -101,6 +120,69 @@ std::map<std::string, Beat> g_beats;  // worker -> last heartbeat
 std::atomic<uint64_t> g_seq{0};
 std::atomic<uint64_t> g_id{0};
 std::string g_token;  // empty = open broker (dev/test direct spawns)
+
+// Leader-handover state (docs/RESILIENCE.md "Broker failover").
+std::atomic<uint64_t> g_epoch{0};
+std::atomic<uint64_t> g_repl_seq{0};  // entries journaled as primary
+std::atomic<uint64_t> g_sync_seq{0};  // entries applied as standby
+std::mutex g_role_mu;
+std::string g_role = "primary";
+std::mutex g_repl_mu;
+std::FILE* g_repl_fh = nullptr;  // DLCFN_BROKER_REPL_LOG, nullptr = off
+
+std::string current_role() {
+  std::lock_guard<std::mutex> lock(g_role_mu);
+  return g_role;
+}
+
+void set_role(const std::string& role) {
+  std::lock_guard<std::mutex> lock(g_role_mu);
+  g_role = role;
+}
+
+std::string json_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out.push_back(static_cast<char>(c));
+        }
+    }
+  }
+  return out;
+}
+
+// Append one replication entry in the flight-recorder JSONL shape
+// (obs/recorder.py): the streamer tails this file with read_journal /
+// follow_journal and replays each frame into the standby via SYNC.
+uint64_t repl_append(const std::string& frame) {
+  uint64_t seq = ++g_repl_seq;
+  std::lock_guard<std::mutex> lock(g_repl_mu);
+  if (g_repl_fh != nullptr) {
+    double ts = std::chrono::duration<double>(
+                    std::chrono::system_clock::now().time_since_epoch())
+                    .count();
+    std::fprintf(g_repl_fh,
+                 "{\"ts\": %.6f, \"kind\": \"broker_apply\", \"seq\": %llu, "
+                 "\"epoch\": %llu, \"frame\": \"%s\"}\n",
+                 ts, static_cast<unsigned long long>(seq),
+                 static_cast<unsigned long long>(g_epoch.load()),
+                 json_escape(frame).c_str());
+    std::fflush(g_repl_fh);
+  }
+  return seq;
+}
 
 // Constant-time comparison: the token check must not leak prefix length
 // through timing.
@@ -166,8 +248,29 @@ std::string op_send(const std::string& qname, std::string body) {
   m.seq = ++g_seq;
   m.invisible_until = Clock::time_point{};  // immediately visible
   std::string id = m.id;
+  q.applied.insert(id);  // a replayed copy of this send must dedup on it
   q.messages.emplace(id, std::move(m));
   return id;
+}
+
+// Idempotent enqueue: the rid doubles as the message id, and a rid seen
+// before (failover re-send, duplicate replication entry) is a no-op.
+// ``applied`` (when given) reports whether this call enqueued, so the
+// caller journals a replication entry only for real state changes.
+std::string op_send_id(const std::string& qname, const std::string& rid,
+                       std::string body, bool* applied = nullptr) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  Queue& q = g_queues[qname];
+  if (applied != nullptr) *applied = false;
+  if (!q.applied.insert(rid).second) return rid;
+  if (applied != nullptr) *applied = true;
+  Stored m;
+  m.id = rid;
+  m.body = std::move(body);
+  m.seq = ++g_seq;
+  m.invisible_until = Clock::time_point{};
+  q.messages.emplace(rid, std::move(m));
+  return rid;
 }
 
 struct Delivered {
@@ -199,16 +302,25 @@ std::vector<Delivered> op_recv(const std::string& qname, int max_messages,
   return out;
 }
 
-bool op_del(const std::string& qname, const std::string& receipt) {
+// Returns the deleted message id, or "" for an unknown receipt (no-op,
+// like SQS).  The id is what replication journals: receipts are minted
+// per-delivery on this process and mean nothing to a standby.
+std::string op_del(const std::string& qname, const std::string& receipt) {
   std::lock_guard<std::mutex> lock(g_mu);
   Queue& q = g_queues[qname];
   for (auto it = q.messages.begin(); it != q.messages.end(); ++it) {
     if (it->second.receipts.count(receipt)) {
+      std::string mid = it->first;
       q.messages.erase(it);
-      return true;
+      return mid;
     }
   }
-  return false;  // unknown receipt: no-op, like SQS
+  return "";
+}
+
+bool op_del_id(const std::string& qname, const std::string& mid) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  return g_queues[qname].messages.erase(mid) > 0;
 }
 
 size_t op_depth(const std::string& qname) {
@@ -265,6 +377,68 @@ std::vector<BeatRow> op_heartbeats() {
   return out;
 }
 
+// --- replication replay --------------------------------------------------
+
+// Replay one replication frame into local state.  Frames are the
+// primary's journaled mutations — SENDID/DELID/PURGE/SET/UNSET/HEARTBEAT
+// — and replay is idempotent: SENDID dedups on rid, DELID on message id,
+// SET/UNSET/PURGE are last-write-wins, and the SYNC handler additionally
+// drops whole duplicate entries by seq.  RECV leases are deliberately
+// not replicated: receipts are per-process, so unacked messages simply
+// reappear on the promoted standby (at-least-once, like SQS).
+bool apply_frame(const std::string& frame) {
+  std::string head = frame.substr(0, frame.find('\n'));
+  size_t off = head.size() < frame.size() ? head.size() + 1 : frame.size();
+  std::istringstream hs(head);
+  std::string av;
+  hs >> av;
+  if (av == "SENDID") {
+    std::string qname, rid;
+    size_t len = 0;
+    hs >> qname >> rid >> len;
+    if (qname.empty() || rid.empty()) return false;
+    op_send_id(qname, rid, frame.substr(off));
+    return true;
+  }
+  if (av == "DELID") {
+    std::string qname, mid;
+    hs >> qname >> mid;
+    if (qname.empty() || mid.empty()) return false;
+    op_del_id(qname, mid);
+    return true;
+  }
+  if (av == "PURGE") {
+    std::string qname;
+    hs >> qname;
+    if (qname.empty()) return false;
+    op_purge(qname);
+    return true;
+  }
+  if (av == "SET") {
+    std::string key;
+    size_t len = 0;
+    hs >> key >> len;
+    if (key.empty()) return false;
+    op_set(key, frame.substr(off));
+    return true;
+  }
+  if (av == "UNSET") {
+    std::string key;
+    hs >> key;
+    if (key.empty()) return false;
+    op_unset(key);
+    return true;
+  }
+  if (av == "HEARTBEAT") {
+    std::string worker;
+    hs >> worker;
+    if (worker.empty()) return false;
+    op_heartbeat(worker);
+    return true;
+  }
+  return false;
+}
+
 // --- per-connection loop -------------------------------------------------
 
 void serve(int fd) {
@@ -301,7 +475,13 @@ void serve(int fd) {
       ss >> qname >> len;
       std::string body;
       if (qname.empty() || len > (64u << 20) || !read_exact(fd, body, len)) break;
-      std::string id = op_send(qname, std::move(body));
+      if (current_role() != "primary") {
+        if (!write_all(fd, "ERR not primary\n")) break;
+        continue;
+      }
+      std::string id = op_send(qname, body);
+      repl_append("SENDID " + qname + " " + id + " " +
+                  std::to_string(body.size()) + "\n" + body);
       if (!write_all(fd, "OK " + id + "\n")) break;
     } else if (cmd == "RECV") {
       std::string qname;
@@ -309,6 +489,12 @@ void serve(int fd) {
       long vis_ms = 0;
       ss >> qname >> maxm >> vis_ms;
       if (qname.empty()) break;
+      // Leases mutate visibility state; a standby serving them would
+      // diverge from the stream it is replaying.
+      if (current_role() != "primary") {
+        if (!write_all(fd, "ERR not primary\n")) break;
+        continue;
+      }
       auto msgs = op_recv(qname, maxm, vis_ms);
       std::string resp = "N " + std::to_string(msgs.size()) + "\n";
       for (auto& m : msgs) {
@@ -319,7 +505,13 @@ void serve(int fd) {
     } else if (cmd == "DEL") {
       std::string qname, receipt;
       ss >> qname >> receipt;
-      if (!write_all(fd, op_del(qname, receipt) ? "OK\n" : "MISS\n")) break;
+      if (current_role() != "primary") {
+        if (!write_all(fd, "ERR not primary\n")) break;
+        continue;
+      }
+      std::string mid = op_del(qname, receipt);
+      if (!mid.empty()) repl_append("DELID " + qname + " " + mid + "\n");
+      if (!write_all(fd, mid.empty() ? "MISS\n" : "OK\n")) break;
     } else if (cmd == "DEPTH") {
       std::string qname;
       ss >> qname;
@@ -327,7 +519,12 @@ void serve(int fd) {
     } else if (cmd == "PURGE") {
       std::string qname;
       ss >> qname;
+      if (current_role() != "primary") {
+        if (!write_all(fd, "ERR not primary\n")) break;
+        continue;
+      }
       op_purge(qname);
+      repl_append("PURGE " + qname + "\n");
       if (!write_all(fd, "OK\n")) break;
     } else if (cmd == "SET") {
       std::string key;
@@ -335,12 +532,24 @@ void serve(int fd) {
       ss >> key >> len;
       std::string value;
       if (key.empty() || len > (64u << 20) || !read_exact(fd, value, len)) break;
-      op_set(key, std::move(value));
+      if (current_role() != "primary") {
+        if (!write_all(fd, "ERR not primary\n")) break;
+        continue;
+      }
+      op_set(key, value);
+      repl_append("SET " + key + " " + std::to_string(value.size()) + "\n" +
+                  value);
       if (!write_all(fd, "OK\n")) break;
     } else if (cmd == "UNSET") {
       std::string key;
       ss >> key;
-      if (!write_all(fd, op_unset(key) ? "OK\n" : "MISS\n")) break;
+      if (current_role() != "primary") {
+        if (!write_all(fd, "ERR not primary\n")) break;
+        continue;
+      }
+      bool removed = op_unset(key);
+      if (removed) repl_append("UNSET " + key + "\n");
+      if (!write_all(fd, removed ? "OK\n" : "MISS\n")) break;
     } else if (cmd == "HEARTBEAT") {
       std::string worker;
       ss >> worker;
@@ -354,9 +563,78 @@ void serve(int fd) {
         }
         if (!write_all(fd, resp)) break;
       } else {
+        if (current_role() != "primary") {
+          if (!write_all(fd, "ERR not primary\n")) break;
+          continue;
+        }
         uint64_t count = op_heartbeat(worker);
+        repl_append("HEARTBEAT " + worker + "\n");
         if (!write_all(fd, "OK " + std::to_string(count) + "\n")) break;
       }
+    } else if (cmd == "SENDID") {
+      std::string qname, rid;
+      size_t len = 0;
+      ss >> qname >> rid >> len;
+      std::string body;
+      if (qname.empty() || rid.empty() || len > (64u << 20) ||
+          !read_exact(fd, body, len)) break;
+      if (current_role() != "primary") {
+        if (!write_all(fd, "ERR not primary\n")) break;
+        continue;
+      }
+      bool applied = false;
+      std::string id = op_send_id(qname, rid, body, &applied);
+      if (applied)
+        repl_append("SENDID " + qname + " " + id + " " +
+                    std::to_string(body.size()) + "\n" + body);
+      if (!write_all(fd, "OK " + id + "\n")) break;
+    } else if (cmd == "ROLE") {
+      uint64_t seq = current_role() == "primary" ? g_repl_seq.load()
+                                                 : g_sync_seq.load();
+      std::string resp;
+      resp += "ROLE " + current_role() + " " + std::to_string(g_epoch.load()) +
+              " " + std::to_string(seq) + "\n";
+      if (!write_all(fd, resp)) break;
+    } else if (cmd == "PROMOTE") {
+      uint64_t epoch = 0;
+      ss >> epoch;
+      if (epoch <= g_epoch.load()) {
+        if (!write_all(fd, "ERR stale epoch\n")) break;
+        continue;
+      }
+      g_epoch.store(epoch);
+      // The promoted standby continues the replication stream from its
+      // replay position, so every entry it acked stays acked.
+      if (g_sync_seq.load() > g_repl_seq.load())
+        g_repl_seq.store(g_sync_seq.load());
+      set_role("primary");
+      if (!write_all(fd, "OK " + std::to_string(epoch) + "\n")) break;
+    } else if (cmd == "SYNC") {
+      uint64_t epoch = 0, seq = 0;
+      size_t len = 0;
+      ss >> epoch >> seq >> len;
+      std::string entry;
+      if (len > (64u << 20) || !read_exact(fd, entry, len)) break;
+      // Epoch fencing: a deposed primary streaming at a stale epoch must
+      // not mutate the new leader's state (the split-brain guard), and a
+      // current primary never accepts its own epoch back as a stream.
+      if (epoch < g_epoch.load() ||
+          (epoch == g_epoch.load() && current_role() == "primary")) {
+        if (!write_all(fd, "ERR fenced\n")) break;
+        continue;
+      }
+      if (epoch > g_epoch.load()) {
+        g_epoch.store(epoch);
+        set_role("standby");  // a higher epoch exists: we are deposed
+      }
+      if (seq > g_sync_seq.load()) {
+        if (!apply_frame(entry)) {
+          if (!write_all(fd, "ERR bad frame\n")) break;
+          continue;
+        }
+        g_sync_seq.store(seq);
+      }
+      if (!write_all(fd, "OK " + std::to_string(seq) + "\n")) break;
     } else if (cmd == "GET") {
       std::string key;
       ss >> key;
@@ -426,6 +704,12 @@ void accept_loop(int listener) {
 int main(int argc, char** argv) {
   if (const char* tok = std::getenv("DLCFN_BROKER_TOKEN"))
     g_token = tok;
+  if (const char* role = std::getenv("DLCFN_BROKER_ROLE"))
+    g_role = role;
+  if (const char* epoch = std::getenv("DLCFN_BROKER_EPOCH"))
+    g_epoch.store(std::strtoull(epoch, nullptr, 10));
+  if (const char* repl = std::getenv("DLCFN_BROKER_REPL_LOG"))
+    g_repl_fh = std::fopen(repl, "a");
   int port = argc > 1 ? std::atoi(argv[1]) : 8477;
   std::string addrs_arg = argc > 2 ? argv[2] : "*";
   std::vector<std::string> addrs;

@@ -19,17 +19,22 @@ echo "== chaos scenarios (seeded, virtual-clock — docs/RESILIENCE.md) =="
 # and needs 8 virtual CPU devices before the JAX backend initializes, and
 # serve-replica-loss, which kills a serving replica mid-traffic and
 # asserts zero lost accepted requests plus the p99 latency SLO
-# (docs/SERVING.md runbook).
+# (docs/SERVING.md runbook).  broker-failover runs the 1k-agent
+# warm-standby soak (zero lost INSTANCE_TERMINATE, exactly-once
+# re-sends) and split-brain proves epoch fencing rejects every
+# stale-primary write.
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -m deeplearning_cfn_tpu.cli chaos --all --seed 0 \
   > /tmp/_chaos.json || { cat /tmp/_chaos.json; exit 1; }
 python - <<'EOF' || exit 1
-# The serving plane's SLO gate must actually have run: --all is dynamic,
-# so pin the one scenario this gate newly depends on.
+# The gates this script newly depends on must actually have run: --all is
+# dynamic, so pin the serving SLO scenario and the control-plane failover
+# pair (broker-failover's 1k-agent soak, split-brain's epoch fencing).
 import json
 reports = json.load(open("/tmp/_chaos.json"))
 names = {r["scenario"] for r in reports}
-assert "serve-replica-loss" in names, f"serve-replica-loss missing from {sorted(names)}"
+for required in ("serve-replica-loss", "broker-failover", "split-brain"):
+    assert required in names, f"{required} missing from {sorted(names)}"
 EOF
 echo "chaos: all scenarios held their invariants (report: /tmp/_chaos.json)"
 

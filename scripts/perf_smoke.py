@@ -11,6 +11,8 @@ is ~30x the profiler's actual per-step cost, median-of-3 to shrug off
 scheduler noise; the step-time regression sentinel asserts ordering
 (p99 >= p50) and a deliberately loose absolute ceiling.
 docs/PERFORMANCE.md covers how to read the timing counters it prints.
+A serving-plane scheduler stage and a 1k-agent broker-failover soak
+(both on virtual clocks, structural asserts only) ride along.
 
 Exit 0 and one JSON line on success; exit 1 with a message on violation.
 """
@@ -207,6 +209,61 @@ def serve_scheduler() -> tuple[dict, list[str]]:
     }, failures
 
 
+BROKER_SOAK_AGENTS = 1000
+BROKER_SOAK_SENDERS = 100
+
+
+def broker_soak() -> tuple[dict, list[str]]:
+    """Control-plane failover stage: structural asserts only, no
+    wall-clock.  Runs the 1k-agent warm-standby soak on a virtual clock
+    (primary killed mid-term, standby promoted, clients blind-re-send)
+    and checks the control plane's contracts: every killed agent's
+    INSTANCE_TERMINATE fires exactly once across the failover, the
+    idempotent re-send storm lands exactly-once, the promoted standby
+    replays every shipped journal entry, and no write was fenced in a
+    clean (single-partition) failover."""
+    from deeplearning_cfn_tpu.analysis.schedules import soak_failover
+
+    failures: list[str] = []
+    soak = soak_failover(agents=BROKER_SOAK_AGENTS, seed=0)
+    if soak["lost_terminates"]:
+        failures.append(
+            f"broker failover lost {soak['lost_terminates']} "
+            f"INSTANCE_TERMINATE events"
+        )
+    for kind in ("spurious", "duplicate", "premature"):
+        if soak[f"{kind}_terminates"]:
+            failures.append(
+                f"broker failover produced {soak[f'{kind}_terminates']} "
+                f"{kind} terminates"
+            )
+    if soak["duplicate_sends"] or soak["work_depth"] != BROKER_SOAK_SENDERS:
+        failures.append(
+            f"idempotent re-send not exactly-once: depth "
+            f"{soak['work_depth']}/{BROKER_SOAK_SENDERS}, "
+            f"{soak['duplicate_sends']} duplicates"
+        )
+    # Bounded replay lag: the promoted standby holds every entry the
+    # primary shipped before dying — journaled minus replayed is exactly
+    # the tail the kill left unshipped, never more.
+    if soak["replayed_seq"] != soak["journaled_seq"] - soak["unshipped_at_kill"]:
+        failures.append(
+            f"standby replay lag unbounded: replayed {soak['replayed_seq']} "
+            f"of {soak['journaled_seq']} journaled "
+            f"({soak['unshipped_at_kill']} unshipped at kill)"
+        )
+    if soak["fenced_writes"]:
+        failures.append(
+            f"clean failover fenced {soak['fenced_writes']} writes"
+        )
+    if soak["client_failovers"] != BROKER_SOAK_SENDERS:
+        failures.append(
+            f"client failover count {soak['client_failovers']} != "
+            f"{BROKER_SOAK_SENDERS} senders"
+        )
+    return soak, failures
+
+
 def main() -> int:
     u8_snap, u8_x = run_pipeline("uint8")
     f32_snap, f32_x = run_pipeline("float32")
@@ -288,6 +345,9 @@ def main() -> int:
     serve_snap, serve_failures = serve_scheduler()
     failures.extend(serve_failures)
 
+    broker_snap, broker_failures = broker_soak()
+    failures.extend(broker_failures)
+
     if failures:
         for f in failures:
             print(f"perf-smoke: {f}", file=sys.stderr)
@@ -307,6 +367,7 @@ def main() -> int:
                 },
                 "step_ms": snap["step_ms"],
                 "serve": serve_snap,
+                "broker_failover": broker_snap,
             },
             allow_nan=False,
         )

@@ -1,0 +1,192 @@
+"""Client-side broker failover (cluster/broker_client.py,
+FailoverBrokerConnection): endpoint walking, outage classification, and
+idempotent re-send — all through the ``dial`` seam, no native broker, no
+wall clock.
+
+The load-bearing regression here is satellite #2 of the replicated
+control plane: a SUCCESSFUL failover is not an outage.  It must journal
+``broker_failover``, reset the adopted endpoint's breaker, and leave the
+failed endpoint's breaker holding exactly the failures that endpoint
+earned — never bleed them into a shared budget.
+"""
+
+import pytest
+
+from deeplearning_cfn_tpu.cluster.broker_client import (
+    BrokerError,
+    FailoverBrokerConnection,
+    endpoints_from_record,
+)
+from deeplearning_cfn_tpu.obs import recorder as recorder_mod
+from deeplearning_cfn_tpu.obs.recorder import FlightRecorder
+from deeplearning_cfn_tpu.utils.timeouts import FakeClock
+
+
+class FakeBroker:
+    """One in-memory endpoint behind the dial seam."""
+
+    def __init__(self, primary: bool = True):
+        self.up = True
+        self.primary = primary
+        self.sent: list[tuple[str, str, bytes]] = []
+        self.rids: set[str] = set()
+        self.dials = 0
+        self.die_after_apply = 0  # applies, then drops the connection
+
+    def apply(self, queue: str, body: bytes, rid: str) -> None:
+        if rid not in self.rids:
+            self.rids.add(rid)
+            self.sent.append((queue, rid, body))
+
+
+class FakeConn:
+    def __init__(self, broker: FakeBroker):
+        self.broker = broker
+
+    def ping(self) -> bool:
+        if not self.broker.up:
+            raise ConnectionError("closed connection")
+        return True
+
+    def send_idempotent(self, queue: str, body: bytes, rid: str) -> str:
+        if not self.broker.up:
+            raise ConnectionError("closed connection")
+        if not self.broker.primary:
+            raise BrokerError("SENDID failed: ERR not primary")
+        self.broker.apply(queue, body, rid)
+        if self.broker.die_after_apply:
+            self.broker.die_after_apply -= 1
+            raise ConnectionError("peer closed connection mid-RPC")
+        return rid
+
+    def close(self) -> None:
+        pass
+
+
+def make_pair():
+    """A primary at ('a', 1) and a standby at ('b', 2), plus a dial."""
+    a, b = FakeBroker(primary=True), FakeBroker(primary=False)
+    table = {("a", 1): a, ("b", 2): b}
+
+    def dial(host, port):
+        broker = table[(host, port)]
+        broker.dials += 1
+        if not broker.up:
+            raise ConnectionError("connection refused")
+        return FakeConn(broker)
+
+    return a, b, dial
+
+
+def make_conn(dial, clock=None):
+    return FailoverBrokerConnection(
+        [("a", 1), ("b", 2)], dial=dial, clock=clock or FakeClock()
+    )
+
+
+def test_send_fails_over_to_promoted_standby():
+    a, b, dial = make_pair()
+    conn = make_conn(dial)
+    assert conn.ping()  # established on the primary
+    a.up = False
+    b.primary = True  # the service's _adopt_standby ran
+    assert conn.send_idempotent("work", b"job", "r1") == "r1"
+    assert conn.failovers == 1
+    assert conn.active_endpoint == ("b", 2)
+    assert b.sent == [("work", "r1", b"job")]
+
+
+def test_failover_is_not_an_outage_breaker_regression(monkeypatch):
+    """Satellite #2: after a successful failover the adopted endpoint's
+    breaker is CLOSED with zero failures (the switch consumed none of its
+    budget), the dead endpoint's breaker holds exactly its own failures,
+    and the switch is journaled as broker_failover — not as an outage."""
+    # A private process-wide recorder: the shared ring buffer may already
+    # hold thousands of events from earlier tests, so index math on its
+    # tail is not a stable way to isolate this test's own journal.
+    monkeypatch.setattr(recorder_mod, "_default", FlightRecorder())
+    a, b, dial = make_pair()
+    conn = make_conn(dial)
+    assert conn.ping()
+    a.up = False
+    b.primary = True
+    assert conn.send_idempotent("work", b"job", "r1") == "r1"
+    new = conn.breaker(("b", 2))
+    assert new.state == "closed"
+    assert new.consecutive_failures == 0
+    old = conn.breaker(("a", 1))
+    assert old.consecutive_failures == 1  # the dead endpoint's own dial failure, kept
+    events = [
+        e for e in recorder_mod.get_recorder().tail(500)
+        if e.get("kind") == "broker_failover"
+    ]
+    assert len(events) == 1
+    assert events[0]["from_host"] == "a" and events[0]["to_host"] == "b"
+
+
+def test_resend_after_mid_rpc_death_does_not_double_enqueue():
+    """The at-least-once wire contract: the primary applies the SENDID
+    but dies before the OK — the client's retry (same rid) walks the
+    endpoints, comes back, and the idempotency key dedups the re-apply."""
+    a, b, dial = make_pair()
+    conn = make_conn(dial)
+    a.die_after_apply = 1
+    assert conn.send_idempotent("work", b"job", "r1") == "r1"
+    assert a.sent == [("work", "r1", b"job")]  # applied exactly once
+    assert len(a.rids) == 1
+
+
+def test_not_primary_advances_instead_of_raising():
+    a, b, dial = make_pair()
+    a.primary, b.primary = False, True  # client's record file is stale
+    conn = make_conn(dial)
+    assert conn.send_idempotent("work", b"job", "r1") == "r1"
+    assert b.sent and not a.sent
+    assert conn.breaker(("a", 1)).consecutive_failures == 1
+
+
+def test_open_breaker_skips_endpoint_without_dialing():
+    a, b, dial = make_pair()
+    b.primary = True
+    conn = make_conn(dial)
+    for _ in range(3):  # trip ('a', 1)'s breaker (threshold 3)
+        conn.breaker(("a", 1)).record_failure()
+    assert conn.send_idempotent("work", b"job", "r1") == "r1"
+    assert a.dials == 0  # open breaker = skip, not a dead end
+    assert b.sent == [("work", "r1", b"job")]
+
+
+def test_every_endpoint_down_raises_broker_error():
+    a, b, dial = make_pair()
+    a.up = b.up = False
+    conn = make_conn(dial)
+    with pytest.raises(BrokerError, match="no broker endpoint available"):
+        conn.ping()
+
+
+def test_non_endpoint_errors_propagate():
+    """Application-level rejections (bad arguments, AUTH) are NOT
+    failover triggers — walking endpoints cannot fix them."""
+    a, b, dial = make_pair()
+    conn = make_conn(dial)
+
+    def bad_rpc(c):
+        raise BrokerError("SENDID failed: ERR bad idempotency key")
+
+    with pytest.raises(BrokerError, match="bad idempotency key"):
+        conn._call("send_idempotent", bad_rpc)
+    assert conn.breaker(("a", 1)).consecutive_failures == 0
+
+
+def test_endpoints_from_record_shapes():
+    replicated = {
+        "host": "10.0.0.1",
+        "port": 8477,
+        "endpoints": [["10.0.0.1", 8477], ["10.0.0.2", 9001]],
+    }
+    assert endpoints_from_record(replicated) == [
+        ("10.0.0.1", 8477),
+        ("10.0.0.2", 9001),
+    ]
+    legacy = {"host": "10.0.0.1", "port": 8477}
+    assert endpoints_from_record(legacy) == [("10.0.0.1", 8477)]

@@ -504,6 +504,105 @@ def test_teardown_without_record_is_noop(tmp_path):
     assert teardown_broker("none", root=tmp_path) == {"broker": "none"}
 
 
+# --- warm standby / failover ------------------------------------------------
+
+
+def test_standby_lifecycle_and_adoption(tmp_path):
+    """The failover path end to end: ensure_standby_broker spawns the
+    replica and publishes the endpoint list; when the primary dies,
+    ensure_broker ADOPTS the live standby — promotion RPC, epoch bump,
+    record rewrite — instead of spawning a fresh process."""
+    import signal
+    import time
+
+    from deeplearning_cfn_tpu.cluster.broker_service import (
+        broker_replication_status,
+        ensure_standby_broker,
+        standby_broker_status,
+    )
+
+    _, port, _ = ensure_broker("svc", root=tmp_path)
+    try:
+        sb_host, sb_port, sb_started = ensure_standby_broker("svc", root=tmp_path)
+        assert sb_started is True and sb_port != port
+        assert standby_broker_status("svc", root=tmp_path)["alive"] is True
+        # The standby record carries the operator-only bit and the shared
+        # AUTH token (clients fail over without a second secret).
+        sb_rec_file = tmp_path / "broker" / "svc.standby.json"
+        assert (sb_rec_file.stat().st_mode & 0o777) == 0o600
+        rec = json.loads((tmp_path / "broker" / "svc.json").read_text())
+        assert rec["endpoints"] == [["127.0.0.1", port], [sb_host, sb_port]]
+        repl = broker_replication_status("svc", root=tmp_path)
+        assert repl["primary"]["role"] == "primary"
+        assert repl["standby"]["role"] == "standby"
+
+        os.kill(int(rec["pid"]), signal.SIGKILL)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if not broker_status("svc", root=tmp_path)["alive"]:
+                break
+            time.sleep(0.05)
+        host2, port2, started2 = ensure_broker("svc", root=tmp_path)
+        # Adoption, not a respawn: the standby's port, nothing started.
+        assert (host2, port2, started2) == ("127.0.0.1", sb_port, False)
+        rec2 = json.loads((tmp_path / "broker" / "svc.json").read_text())
+        assert rec2["role"] == "primary"
+        assert int(rec2["epoch"]) >= 1  # the promotion ladder bumped it
+        assert not sb_rec_file.exists()  # the replica slot is vacated
+        repl2 = broker_replication_status("svc", root=tmp_path)
+        assert repl2["primary"]["role"] == "primary"
+        assert repl2["primary"]["alive"] is True
+    finally:
+        out = teardown_broker("svc", root=tmp_path)
+    assert broker_status("svc", root=tmp_path) is None
+    with pytest.raises(ProcessLookupError):
+        os.kill(int(out["pid"]), 0)
+
+
+def test_stale_standby_record_does_not_shadow_dead_primary(tmp_path):
+    """Both records dead: ensure must discard the stale standby record
+    and spawn fresh — never hand clients a standby address nothing
+    listens on."""
+    rec_dir = tmp_path / "broker"
+    rec_dir.mkdir(parents=True)
+    (rec_dir / "svc.json").write_text(
+        json.dumps({"cluster": "svc", "host": "127.0.0.1", "port": 1, "pid": 1})
+    )
+    (rec_dir / "svc.standby.json").write_text(
+        json.dumps(
+            {"cluster": "svc", "host": "127.0.0.1", "port": 2, "pid": 1,
+             "role": "standby", "epoch": 0}
+        )
+    )
+    host, port, started = ensure_broker("svc", root=tmp_path)
+    try:
+        assert started is True
+        assert port not in (1, 2)
+        assert not (rec_dir / "svc.standby.json").exists()
+        assert broker_status("svc", root=tmp_path)["alive"] is True
+    finally:
+        teardown_broker("svc", root=tmp_path)
+
+
+def test_teardown_reaps_standby_and_replication_log(tmp_path):
+    """delete's stack-resource contract covers the whole replicated pair:
+    standby process, standby record, and the replication journal all go."""
+    from deeplearning_cfn_tpu.cluster.broker_service import ensure_standby_broker
+
+    ensure_broker("svc", root=tmp_path)
+    _, _, _ = ensure_standby_broker("svc", root=tmp_path)
+    sb_pid = int(
+        json.loads((tmp_path / "broker" / "svc.standby.json").read_text())["pid"]
+    )
+    out = teardown_broker("svc", root=tmp_path)
+    assert out["broker"] == "stopped"
+    assert out["standby"]["broker"] == "stopped"
+    with pytest.raises(ProcessLookupError):
+        os.kill(sb_pid, 0)
+    assert not (tmp_path / "broker" / "svc.standby.json").exists()
+    assert not (tmp_path / "broker" / "svc.repl.jsonl").exists()
+
+
 def test_advertise_address_is_recorded(tmp_path):
     host, port, _ = ensure_broker("adv", root=tmp_path, advertise="10.1.2.3")
     try:

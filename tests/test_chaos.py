@@ -47,7 +47,7 @@ def test_scenario_reports_deterministic_per_seed(name):
 
 def test_unknown_scenario_names_the_catalog():
     with pytest.raises(KeyError, match="flaky-rpc"):
-        run_scenario("split-brain", 0)
+        run_scenario("no-such-scenario", 0)
 
 
 # --- CLI ---------------------------------------------------------------------

@@ -7,13 +7,23 @@ LivenessTable / EventBus objects over virtual time, with every
 transition and every INSTANCE_TERMINATE checked against the broker's own
 ground-truth silence.  No real threads, no sleeps, no wall clock — a
 failing schedule replays byte-for-byte from its seed.
+
+The second half races the replicated control plane itself: seeded
+schedules where the primary broker dies mid-RPC (writes applied and
+journaled, acks lost, replication mid-stream), asserting the promoted
+standby's replayed queue/KV state carries no duplicate side effects —
+idempotency keys are honored across at-least-once shipping and the
+clients' blind post-failover re-send storm.
 """
 
 import pytest
 
 from deeplearning_cfn_tpu.analysis.schedules import (
+    FailoverSimConnection,
     HeartbeatChoreography,
     InvariantViolation,
+    ReplicatedSimBroker,
+    SimBrokerError,
     StepScheduler,
     VirtualClock,
     interleavings,
@@ -135,3 +145,81 @@ def test_virtual_clock_is_monotonic():
     assert clock() == 5.0
     with pytest.raises(ValueError):
         clock.advance(-1.0)
+
+
+# --- primary-dies-mid-RPC schedules (replicated control plane) --------------
+
+# The raced region: three client writes, two replication-streamer passes,
+# and the primary's death shuffle freely.  Depending on the ordering a
+# write may be (a) applied+journaled+shipped, (b) applied+journaled but
+# unshipped (the ack was lost mid-RPC), or (c) never accepted (the kill
+# won the race) — the client cannot tell these apart, so it blind
+# re-sends every rid after the failover.  Exactly-once must hold anyway.
+RPC_RACE = ("rpc:r0", "rpc:r1", "rpc:r2", "stream", "stream", "kill")
+RPC_RIDS = ("r0", "r1", "r2")
+
+
+def test_primary_death_mid_rpc_no_duplicate_side_effects():
+    middles = interleavings(RPC_RACE, count=56, seed=13)
+    assert len(set(middles)) == 56
+    for middle in middles:
+        clock = VirtualClock()
+        cluster = ReplicatedSimBroker(clock)
+        conn = FailoverSimConnection(cluster.nodes())
+        acked: set[str] = set()
+        for action in middle:
+            clock.advance(1.0)
+            if action == "kill":
+                cluster.kill_primary()
+            elif action == "stream":
+                try:
+                    cluster.stream()
+                except SimBrokerError:
+                    pass  # streamer dialed a dead primary: the outage
+            else:
+                rid = action.split(":", 1)[1]
+                try:
+                    conn.send_idempotent("work", b"job", rid)
+                    acked.add(rid)
+                except SimBrokerError:
+                    pass  # died mid-RPC (or during the outage window)
+        cluster.promote_standby()
+        # Blind at-least-once recovery: every rid re-sent, acked or not.
+        for rid in RPC_RIDS:
+            conn.send_idempotent("work", b"job", rid)
+        queue = [rid for rid, _ in cluster.standby.queues.get("work", [])]
+        assert sorted(queue) == sorted(RPC_RIDS), (middle, queue)
+        assert len(set(queue)) == len(queue), (middle, queue)
+        # Acked writes survived the failover — a warm standby plus rid
+        # replay loses nothing the client was told had landed.
+        assert acked <= set(queue)
+
+
+def test_replayed_journal_is_idempotent_on_standby():
+    """At-least-once shipping: replaying the ENTIRE journal over entries
+    the standby already applied must change nothing — seq watermarking
+    dedups frames, idempotency keys dedup queue bodies, SET replays
+    last-write-wins into the same KV value."""
+    clock = VirtualClock()
+    cluster = ReplicatedSimBroker(clock)
+    primary = cluster.primary
+    for i in range(5):
+        clock.advance(1.0)
+        primary.send_idempotent("work", f"b{i}".encode(), f"r{i}")
+    primary.set("leader", b"broker-a")
+    primary.record("w0")
+    assert cluster.stream() == 7
+    snap = (
+        dict(cluster.standby.queues),
+        dict(cluster.standby.kv),
+        cluster.standby.sync_seq,
+    )
+    for entry in primary.journal:  # the whole stream, from seq 1
+        cluster.standby.sync(entry["epoch"], entry["seq"], entry["frame"])
+    assert (
+        dict(cluster.standby.queues),
+        dict(cluster.standby.kv),
+        cluster.standby.sync_seq,
+    ) == snap
+    # And nothing was fenced: same epoch, standby role, clean replay.
+    assert cluster.standby.fenced == 0

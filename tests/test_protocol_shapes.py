@@ -34,20 +34,30 @@ def test_shape_extraction_is_not_vacuous():
     assert canon["RECV"] == {(3, False)}
     # HEARTBEAT's two spec lines: record (1 arg) and table dump (0 args).
     assert canon["HEARTBEAT"] == {(0, False), (1, False)}
+    # The replication verbs (warm-standby control plane, PR 10).
+    assert canon["SENDID"] == {(3, True)}  # SENDID <queue> <rid> <nbytes>
+    assert canon["ROLE"] == {(0, False)}
+    assert canon["PROMOTE"] == {(1, False)}
+    assert canon["SYNC"] == {(3, True)}  # SYNC <epoch> <seq> <nbytes>
 
     cpp = ps.cpp_request_shapes()
     assert cpp["RECV"] == (3, False)
     assert cpp["SET"][1] is True  # kv write reads a payload
+    assert cpp["SYNC"] == (3, True)  # journal frame rides the payload
+    assert cpp["PROMOTE"] == (1, False)
 
     client_tokens, client_frames = ps.client_reply_contract()
     assert "PONG" in client_tokens["PING"]
     assert client_frames["RECV"]["MSG"] == {5}
     assert client_frames["HEARTBEAT"]["HB"] == {4}
+    # ROLE replies with a 4-token frame: ROLE <role> <epoch> <seq>.
+    assert client_frames["ROLE"]["ROLE"] == {4}
 
     cpp_tokens, cpp_frames = ps.cpp_reply_contract()
     assert "PONG" in cpp_tokens["PING"]
     assert cpp_frames["RECV"]["MSG"] == 5
     assert cpp_frames["HEARTBEAT"]["HB"] == 4
+    assert cpp_frames["ROLE"]["ROLE"] == 4
 
 
 def _mutated(tmp_path: Path, src: Path, old: str, new: str) -> Path:
