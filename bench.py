@@ -171,8 +171,9 @@ def main() -> None:
     with CompileWatcher() as watcher:
         state = trainer.init(jax.random.key(0), x)
         # Cost analysis before any donated execution: flops per compiled
-        # step is the MFU numerator.
-        stats = trainer.compile_stats(state, x, y)
+        # step is the MFU numerator.  Keep the executable: its HLO is the
+        # comms block's source (collectives + peak HBM).
+        stats, step_exe = trainer.compile_stats(state, x, y, return_compiled=True)
         flops_per_step = stats.get("flops_per_step")
 
         step = trainer.step_fn
@@ -234,7 +235,8 @@ def main() -> None:
             # — it populates the jit dispatch cache under this mesh, so
             # the warmup dispatch below hits the cache instead of
             # compiling a second time (compile_count unchanged).
-            kcost = program_cost(kfn.lower(state, xs, ys).compile())
+            kexe = kfn.lower(state, xs, ys).compile()
+            kcost = program_cost(kexe)
             for _ in range(max(1, WARMUP_STEPS // k)):
                 state, losses = kfn(state, xs, ys)
             float(np.asarray(jax.device_get(losses))[-1])
@@ -308,6 +310,25 @@ def main() -> None:
             else None,
         },
     }
+    # Communication + HBM pressure per compiled program, read straight
+    # off the executables' HLO/memory analysis (the other two MFU
+    # killers the step-time blocks can't see — docs/STATIC_ANALYSIS.md
+    # comms runbook).  Bytes are normalized per STEP so single- and
+    # multi-step modes compare directly.
+    from deeplearning_cfn_tpu.analysis.comms_audit import program_comms
+
+    def comms_block(exe, steps_per_call: int) -> dict:
+        c = program_comms(exe)
+        return {
+            "collective_count": c["collective_count"],
+            "collective_bytes_per_step": c["collective_bytes"] // steps_per_call,
+            "peak_hbm_bytes": c["peak_hbm_bytes"],
+        }
+
+    comms = {
+        "train_step": comms_block(step_exe, 1),
+        f"multi_step_k{k}": comms_block(kexe, k),
+    }
     # Per-compiled-program MFU/MBU from each program's own cost model
     # and measured call time — attribution finer than whole-bench MFU.
     programs = {
@@ -352,6 +373,7 @@ def main() -> None:
                 "compile_count": watcher.compile_count,
                 "retrace_count": watcher.retrace_count,
                 "donated_bytes": donation.donated_bytes,
+                "comms": comms,
                 "flops_per_step": flops_per_step,
                 "device_kind": str(getattr(devices[0], "device_kind", "unknown")),
                 "n_chips": n_chips,

@@ -7,6 +7,7 @@ from pathlib import Path
 from typing import Iterable, Iterator
 
 # Importing rule modules registers them in core.FILE_RULES.
+import deeplearning_cfn_tpu.analysis.collectives as collectives_rules
 import deeplearning_cfn_tpu.analysis.concurrency as concurrency_rules
 import deeplearning_cfn_tpu.analysis.rules  # noqa: F401
 import deeplearning_cfn_tpu.analysis.sharding as sharding_rules
@@ -23,6 +24,14 @@ PROTOCOL_RULE_IDS = (
     protocol.RULE_REPLY,
     protocol.RULE_FRAME,
     protocol.RULE_LIFECYCLE,
+)
+
+# Rules only the dynamic sentinel stages (scripts/compile_audit.py,
+# scripts/comms_audit.py) can produce.  Their baseline entries share
+# scripts/lint_baseline.json with the static pass, so static lint must
+# never call them stale — it cannot observe their findings at all.
+DYNAMIC_AUDIT_RULE_IDS = tuple(sharding_rules.AUDIT_RULE_IDS) + tuple(
+    collectives_rules.AUDIT_RULE_IDS
 )
 
 
@@ -49,6 +58,7 @@ def run_lint(
     concurrency: bool = False,
     protocol_pass: bool = False,
     sharding: bool = False,
+    comms: bool = False,
 ) -> list[Violation]:
     """Lint the given targets (repo defaults when None).
 
@@ -59,8 +69,9 @@ def run_lint(
     The DLC2xx concurrency rules are gated: they run when
     ``concurrency=True`` or a ``select`` names them, never implicitly.
     Likewise the DLC3xx protocol/lifecycle checkers run when
-    ``protocol_pass=True`` or selected, and the DLC4xx trace-safety
-    rules when ``sharding=True`` or selected.
+    ``protocol_pass=True`` or selected, the DLC4xx trace-safety rules
+    when ``sharding=True`` or selected, and the DLC5xx comms/memory
+    rules when ``comms=True`` or selected.
     """
     effective_select = select
     gated_ids: set[str] = set()
@@ -68,6 +79,8 @@ def run_lint(
         gated_ids |= set(concurrency_rules.RULE_IDS)
     if sharding:
         gated_ids |= set(sharding_rules.RULE_IDS)
+    if comms:
+        gated_ids |= set(collectives_rules.RULE_IDS)
     if select is None and gated_ids:
         # Widen the per-file selection to "every ungated rule plus the
         # requested gated passes" — an explicit select is what lets gated
@@ -163,6 +176,28 @@ def write_baseline(
         ],
     }
     Path(path).write_text(json.dumps(payload, indent=2) + "\n")
+
+
+def apply_audit_baseline(
+    violations: list[Violation],
+    baseline_path: Path | str | None,
+    rule_ids: Iterable[str],
+    root: Path = REPO_ROOT,
+) -> tuple[list[Violation], list[tuple[str, str, str]]]:
+    """Namespace-scoped ratchet for the dynamic sentinels.
+
+    A sentinel stage (compile-audit's DLC41x, comms-audit's DLC51x) owns
+    only its own rule namespace inside the shared baseline file: entries
+    for other rules belong to ``dlcfn lint`` and must be invisible here
+    — otherwise every sentinel would nag about every other pass's
+    suppressions as "stale".  Filters the baseline down to ``rule_ids``
+    and returns the usual (fresh findings, stale entries) split.
+    """
+    ids = set(rule_ids)
+    path = Path(baseline_path) if baseline_path is not None else DEFAULT_BASELINE
+    baseline = load_baseline(path) if path.exists() else set()
+    scoped = {entry for entry in baseline if entry[0] in ids}
+    return apply_baseline(violations, scoped, root)
 
 
 def render_text(violations: list[Violation]) -> str:

@@ -744,6 +744,20 @@ def _status_serve(args) -> dict | None:
     return dict(sorted(folded.items())) or None
 
 
+def _status_comms(args) -> dict | None:
+    """Per-program comms budgets (collective count/bytes, peak-HBM
+    estimate) folded from journaled ``comms_audit`` events (latest audit
+    wins), or None (no journal / no audits).  Feeds the
+    ``dlcfn_comms_*`` gauges in the Prometheus rendering."""
+    if not args.journal:
+        return None
+    from deeplearning_cfn_tpu.obs.exporter import fold_comms_events
+    from deeplearning_cfn_tpu.obs.recorder import read_journal
+
+    folded = fold_comms_events(read_journal(args.journal, kind="comms_audit"))
+    return dict(sorted(folded.items())) or None
+
+
 def _status_mesh(args) -> dict | None:
     """The current mesh shape straight from the published cluster
     contract (slices/workers/chips and the degraded flag) — after a live
@@ -834,6 +848,7 @@ def cmd_status(args) -> int:
     mesh = _status_mesh(args)
     profile = _status_profile(args)
     serve = _status_serve(args)
+    comms = _status_comms(args)
     workers = _status_metrics(args.metrics_dir) if args.metrics_dir else None
     if args.metrics_dir and workers is None:
         print(f"no metrics under {args.metrics_dir}", file=sys.stderr)
@@ -852,6 +867,7 @@ def cmd_status(args) -> int:
                 profile=profile,
                 serve=serve,
                 broker=broker,
+                comms=comms,
             ),
             end="",
         )
@@ -865,6 +881,7 @@ def cmd_status(args) -> int:
         and reshard is None
         and profile is None
         and serve is None
+        and comms is None
     ):
         # Metrics-only: the original (round-4) output shape, unchanged.
         print(json.dumps(workers, indent=2))
@@ -886,6 +903,8 @@ def cmd_status(args) -> int:
         out["profile"] = profile
     if serve is not None:
         out["serve"] = serve
+    if comms is not None:
+        out["comms"] = comms
     if workers is not None:
         out["workers"] = workers
     print(json.dumps(out, indent=2))
@@ -1111,10 +1130,12 @@ def cmd_lint(args) -> int:
     Runs the DLC0xx per-file AST rules over the package + scripts and the
     DLC1xx cross-language broker-contract checker; ``--concurrency`` adds
     the DLC2xx lockset rules, ``--protocol`` the DLC3xx message-shape
-    checkers, ``--sharding`` the DLC4xx JAX/SPMD trace-safety rules.
+    checkers, ``--sharding`` the DLC4xx JAX/SPMD trace-safety rules,
+    ``--comms`` the DLC5xx communication/memory rules.
     Exit 1 on findings not covered by ``--baseline``."""
     from deeplearning_cfn_tpu.analysis.runner import (
         DEFAULT_BASELINE,
+        DYNAMIC_AUDIT_RULE_IDS,
         apply_baseline,
         load_baseline,
         render_json,
@@ -1132,6 +1153,7 @@ def cmd_lint(args) -> int:
         concurrency=args.concurrency,
         protocol_pass=args.protocol,
         sharding=args.sharding,
+        comms=args.comms,
     )
 
     baseline_path = args.baseline
@@ -1151,6 +1173,10 @@ def cmd_lint(args) -> int:
             print(f"dlcfn-lint: unreadable baseline {baseline_path}: {exc}")
             return 2
         violations, stale = apply_baseline(violations, baseline)
+        # Dynamic-sentinel entries (DLC41x/DLC51x) are ratcheted by
+        # their own stages; the static pass can't see those findings,
+        # so reporting them stale here would be a standing false nag.
+        stale = [e for e in stale if e[0] not in DYNAMIC_AUDIT_RULE_IDS]
     if args.format == "json":
         print(render_json(violations))
     else:
@@ -1411,7 +1437,7 @@ def main(argv: list[str] | None = None) -> int:
                     metavar="RULES",
                     help="comma-separated rule ids to run (e.g. "
                          "DLC001,DLC100); default: all ungated rules. "
-                         "Naming a gated id (DLC2xx/DLC3xx/DLC4xx) "
+                         "Naming a gated id (DLC2xx/DLC3xx/DLC4xx/DLC5xx) "
                          "enables it.")
     pl.add_argument("--concurrency", action="store_true",
                     help="also run the DLC2xx lockset/thread-escape rules")
@@ -1421,6 +1447,10 @@ def main(argv: list[str] | None = None) -> int:
     pl.add_argument("--sharding", action="store_true",
                     help="also run the DLC4xx JAX/SPMD trace-safety rules "
                          "(retrace/donation/mesh-axis/host-sync)")
+    pl.add_argument("--comms", action="store_true",
+                    help="also run the DLC5xx communication/memory rules "
+                         "(spec consistency/unconstrained intermediates/"
+                         "host gathers/cross-mesh/shard_map reductions)")
     pl.add_argument("--baseline", nargs="?", metavar="PATH", default=None,
                     const=_BASELINE_DEFAULT_SENTINEL,
                     help="suppress findings recorded in this baseline file "

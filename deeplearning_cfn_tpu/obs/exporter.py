@@ -88,6 +88,30 @@ def fold_serve_events(events) -> dict[str, Any]:
     return out
 
 
+def fold_comms_events(events) -> dict[str, Any]:
+    """Fold flight-journal ``comms_audit`` events into the latest budget
+    per audited program (each audit journals a full per-program readout,
+    so last-wins is the fold).  Empty dict when no audit ever ran."""
+    out: dict[str, Any] = {}
+    for event in events:
+        if event.get("kind") != "comms_audit":
+            continue
+        for name, program in (event.get("programs") or {}).items():
+            if not isinstance(program, Mapping):
+                continue
+            out[str(name)] = {
+                k: program.get(k)
+                for k in (
+                    "collective_count",
+                    "collective_bytes",
+                    "peak_hbm_bytes",
+                    "by_op",
+                    "unpredicted_gathers",
+                )
+            }
+    return out
+
+
 def render_prometheus(
     liveness: Mapping[str, Mapping[str, Any]] | None = None,
     spans: Mapping[str, Mapping[str, Any]] | None = None,
@@ -98,6 +122,7 @@ def render_prometheus(
     profile: Mapping[str, Any] | None = None,
     serve: Mapping[str, Mapping[str, Any]] | None = None,
     broker: Mapping[str, Any] | None = None,
+    comms: Mapping[str, Mapping[str, Any]] | None = None,
 ) -> str:
     """Render liveness snapshot + span aggregates + input-pipeline
     counters as Prometheus text.
@@ -113,7 +138,9 @@ def render_prometheus(
     whose per-phase quantiles render as ``dlcfn_step_phase_ms``
     summaries; ``broker`` is
     ``broker_service.broker_replication_status()`` (role/epoch per node
-    plus replication lag).  Any may be None/empty.
+    plus replication lag); ``comms`` is ``fold_comms_events()`` (the
+    comms-audit sentinel's per-program collective/HBM budgets).  Any may
+    be None/empty.
     """
     lines: list[str] = []
     if liveness:
@@ -332,6 +359,33 @@ def render_prometheus(
                 f"{_labels(cluster=cluster, replica=replica)}"
                 f" {snap.get('admitted', 0)}"
             )
+    if comms:
+        for key, help_text in (
+            (
+                "collective_bytes",
+                "Bytes moved by collectives per execution of the audited program.",
+            ),
+            (
+                "peak_hbm_bytes",
+                "Peak-HBM estimate (args + outputs + temps - aliased) of the audited program.",
+            ),
+            (
+                "collective_count",
+                "Collective ops (all-gather/all-reduce/...) in the audited program's HLO.",
+            ),
+        ):
+            lines += [
+                f"# HELP dlcfn_comms_{key} {help_text}",
+                f"# TYPE dlcfn_comms_{key} gauge",
+            ]
+            for program, snap in comms.items():
+                value = snap.get(key)
+                if value is None:
+                    continue
+                lines.append(
+                    f"dlcfn_comms_{key}"
+                    f"{_labels(cluster=cluster, program=program)} {value}"
+                )
     if broker:
         lines += [
             "# HELP dlcfn_broker_role Broker role per node (1 = primary, 0 = standby).",

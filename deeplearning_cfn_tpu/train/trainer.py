@@ -892,7 +892,13 @@ class Trainer:
         return state, losses
 
     # --- compile diagnostics ---------------------------------------------
-    def compile_stats(self, state: TrainState, x: jax.Array, y: jax.Array) -> dict:
+    def compile_stats(
+        self,
+        state: TrainState,
+        x: jax.Array,
+        y: jax.Array,
+        return_compiled: bool = False,
+    ) -> dict | tuple[dict, Any]:
         """AOT-compile the train step and report cost analysis.  NOTE:
         ``flops_per_step`` is PER-DEVICE for an SPMD-partitioned module
         (each device executes the partitioned program over its batch
@@ -907,7 +913,13 @@ class Trainer:
         is the analytic estimate (divided down to per-device scope) and
         ``flops_source`` says so — XLA cost analysis excludes Pallas
         custom-call FLOPs, so on flash-attention paths the raw cost
-        figure (still reported as ``cost_flops_per_step``) under-counts."""
+        figure (still reported as ``cost_flops_per_step``) under-counts.
+
+        ``return_compiled=True`` also returns the AOT executable as
+        ``(stats, compiled)`` so callers (bench.py's comms block, the
+        comms-audit sentinel) can read its HLO/memory analysis without
+        lowering a second time — a second ``lower().compile()`` would
+        count as a retrace in the compile watcher."""
         t0 = time.perf_counter()
         # Same mesh context as train_step: without it, in-model sharding
         # hints are dropped and this would measure (and compile) a different
@@ -931,6 +943,8 @@ class Trainer:
         else:
             out["flops_per_step"] = cost.get("flops")
             out["flops_source"] = "cost_analysis"
+        if return_compiled:
+            return out, compiled
         return out
 
     def throughput_logger(

@@ -2,16 +2,16 @@
 # One gate, two halves: the repo-native lint pass (dlcfn lint with every
 # gated pass on — DLC0xx per-file rules, DLC1xx broker-contract checker,
 # DLC2xx concurrency lockset rules, DLC3xx message-shape/lifecycle
-# checkers, DLC4xx JAX/SPMD trace-safety rules — ratcheted against the
-# committed suppression baseline) then the dynamic gates (chaos,
-# perf-smoke, compile-audit) and the tier-1 test suite — exactly the
-# commands ROADMAP.md designates, so CI and a developer's pre-push run
-# cannot drift apart.
+# checkers, DLC4xx JAX/SPMD trace-safety rules, DLC5xx comms/memory
+# rules — ratcheted against the committed suppression baseline) then
+# the dynamic gates (chaos, perf-smoke, compile-audit, comms-audit) and
+# the tier-1 test suite — exactly the commands ROADMAP.md designates,
+# so CI and a developer's pre-push run cannot drift apart.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== dlcfn lint (full: --concurrency --protocol --sharding, baselined) =="
-python -m deeplearning_cfn_tpu.cli lint --concurrency --protocol --sharding \
+echo "== dlcfn lint (full: --concurrency --protocol --sharding --comms, baselined) =="
+python -m deeplearning_cfn_tpu.cli lint --concurrency --protocol --sharding --comms \
   --baseline scripts/lint_baseline.json || exit 1
 
 echo "== chaos scenarios (seeded, virtual-clock — docs/RESILIENCE.md) =="
@@ -39,7 +39,10 @@ EOF
 echo "chaos: all scenarios held their invariants (report: /tmp/_chaos.json)"
 
 echo "== perf-smoke (compact-dtype input path, structural asserts only) =="
-JAX_PLATFORMS=cpu python scripts/perf_smoke.py || exit 1
+# 8 virtual devices so the comms_budget stage can rebuild the audited
+# fsdp step and hold its collective_bytes to the committed budget.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python scripts/perf_smoke.py || exit 1
 
 echo "== compile-audit sentinel (steady-state zero-retrace + donation) =="
 # Real Trainer.fit() + multi-step path on CPU: any function recompiling
@@ -49,6 +52,17 @@ JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python scripts/compile_audit.py --baseline scripts/lint_baseline.json \
   > /tmp/_compile_audit.json || { cat /tmp/_compile_audit.json; exit 1; }
 echo "compile-audit: steady-state zero retrace, donation effective (report: /tmp/_compile_audit.json)"
+
+echo "== comms-audit sentinel (HLO collective + HBM budget ratchet) =="
+# Lowers the real fsdp train step, multi-step scan body, and serve
+# decode on 8 virtual devices and reads the HLO: collective bytes/count
+# over the committed budget (DLC510) or an all-gather fsdp doesn't
+# predict (DLC511) fails here unless baselined
+# (docs/STATIC_ANALYSIS.md comms runbook).
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python scripts/comms_audit.py --baseline scripts/lint_baseline.json \
+  > /tmp/_comms_audit.json || { cat /tmp/_comms_audit.json; exit 1; }
+echo "comms-audit: collective/HBM budgets within ratchet (report: /tmp/_comms_audit.json)"
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

@@ -46,11 +46,7 @@ def main(argv: list[str] | None = None) -> int:
         run_compile_audit,
         run_serve_audit,
     )
-    from deeplearning_cfn_tpu.analysis.runner import (
-        DEFAULT_BASELINE,
-        apply_baseline,
-        load_baseline,
-    )
+    from deeplearning_cfn_tpu.analysis.runner import apply_audit_baseline
     from deeplearning_cfn_tpu.analysis.sharding import AUDIT_RULE_IDS
 
     report = run_compile_audit(
@@ -66,11 +62,10 @@ def main(argv: list[str] | None = None) -> int:
             key, 0
         )
 
-    baseline_path = args.baseline if args.baseline is not None else DEFAULT_BASELINE
-    baseline = load_baseline(baseline_path) if baseline_path.exists() else set()
     # This stage owns only the dynamic DLC41x namespace; lint owns the rest.
-    audit_baseline = {e for e in baseline if e[0] in AUDIT_RULE_IDS}
-    fresh, stale = apply_baseline(report.violations, audit_baseline)
+    fresh, stale = apply_audit_baseline(
+        report.violations, args.baseline, AUDIT_RULE_IDS
+    )
 
     for rule, rel, message in stale:
         print(

@@ -11,8 +11,10 @@ is ~30x the profiler's actual per-step cost, median-of-3 to shrug off
 scheduler noise; the step-time regression sentinel asserts ordering
 (p99 >= p50) and a deliberately loose absolute ceiling.
 docs/PERFORMANCE.md covers how to read the timing counters it prints.
-A serving-plane scheduler stage and a 1k-agent broker-failover soak
-(both on virtual clocks, structural asserts only) ride along.
+A serving-plane scheduler stage, a 1k-agent broker-failover soak (both
+on virtual clocks, structural asserts only), and an exact-match check of
+the audited train step's collective bytes against the committed comms
+budget (8-virtual-device runs only) ride along.
 
 Exit 0 and one JSON line on success; exit 1 with a message on violation.
 """
@@ -209,6 +211,52 @@ def serve_scheduler() -> tuple[dict, list[str]]:
     }, failures
 
 
+def comms_budget() -> tuple[dict, list[str]]:
+    """Comms-budget stage: the audited fsdp train step's collective
+    bytes must match scripts/comms_budget.json EXACTLY — not a ceiling.
+
+    The audit is pure lower+compile of a fixed program on a fixed mesh,
+    so its HLO (and therefore its collective inventory) is
+    deterministic; any drift in either direction means the partitioner
+    output changed and the budget must be consciously re-measured
+    (scripts/comms_audit.py --write-budget).  Needs the 8 virtual
+    devices check.sh provides; skipped structurally elsewhere so a bare
+    `python scripts/perf_smoke.py` still runs."""
+    from deeplearning_cfn_tpu.analysis.comms_audit import (
+        load_budget,
+        run_comms_audit,
+    )
+
+    failures: list[str] = []
+    budget = load_budget()
+    if budget is None:
+        return {"skipped": "no committed budget"}, failures
+    if jax.device_count() != int(budget.get("device_count", -1)):
+        return {
+            "skipped": f"device_count {jax.device_count()} != "
+            f"budget's {budget.get('device_count')}"
+        }, failures
+    report = run_comms_audit(journal=False, budget_path=None, serve=False)
+    committed = budget.get("programs", {}).get("train_step", {})
+    measured = next(
+        (p for p in report.programs if p.name == "train_step"), None
+    )
+    if measured is None:
+        failures.append("comms audit produced no train_step program")
+        return {}, failures
+    if measured.collective_bytes != int(committed.get("collective_bytes", -1)):
+        failures.append(
+            f"train_step collective_bytes {measured.collective_bytes} != "
+            f"committed {committed.get('collective_bytes')} "
+            "(scripts/comms_budget.json; re-measure deliberately with "
+            "scripts/comms_audit.py --write-budget)"
+        )
+    return {
+        "train_step": measured.budget,
+        "committed": committed,
+    }, failures
+
+
 BROKER_SOAK_AGENTS = 1000
 BROKER_SOAK_SENDERS = 100
 
@@ -348,6 +396,9 @@ def main() -> int:
     broker_snap, broker_failures = broker_soak()
     failures.extend(broker_failures)
 
+    comms_snap, comms_failures = comms_budget()
+    failures.extend(comms_failures)
+
     if failures:
         for f in failures:
             print(f"perf-smoke: {f}", file=sys.stderr)
@@ -368,6 +419,7 @@ def main() -> int:
                 "step_ms": snap["step_ms"],
                 "serve": serve_snap,
                 "broker_failover": broker_snap,
+                "comms": comms_snap,
             },
             allow_nan=False,
         )

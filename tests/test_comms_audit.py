@@ -1,0 +1,276 @@
+"""The dynamic comms-audit sentinel (analysis/comms_audit.py).
+
+Three layers: the HLO readout (``hlo_collectives`` must parse sync and
+async collective instructions with exact byte counts), the DLC511
+golden program (a deliberately missing ``with_sharding_constraint`` on
+an 8-virtual-device fsdp step makes XLA materialize the batch
+replicated — the sentinel must name that gather, and the constrained
+variant must come back parameter-gathers-only), and ``run_comms_audit``
+driving the real Trainer: every program yields a non-empty budget that
+matches scripts/comms_budget.json exactly, and every finding on the
+repo's own hot path is already captured in the ratcheted baseline.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning_cfn_tpu.analysis.collectives import (
+    AUDIT_RULE_BUDGET,
+    AUDIT_RULE_IDS,
+    AUDIT_RULE_UNPREDICTED,
+)
+from deeplearning_cfn_tpu.analysis.comms_audit import (
+    AUDITED_FILE,
+    CommsWatcher,
+    ProgramComms,
+    StrategyPrediction,
+    hlo_collectives,
+    load_budget,
+    run_comms_audit,
+    violations_for,
+    write_budget,
+)
+
+# --- the HLO readout ---------------------------------------------------------
+
+
+def test_hlo_collectives_reads_sync_and_async_ops():
+    """Async ``-start`` ops count once (their ``-done`` halves carry the
+    same bytes) and tuple result shapes keep the u32 control member."""
+    hlo = """\
+  %ag = f32[16,64]{1,0} all-gather(f32[2,64]{1,0} %p0), replica_groups={}
+  %ars = (f32[16,8]{1,0}, u32[]) all-reduce-start(f32[16,8]{1,0} %x), to_apply=%sum
+  %ard = f32[16,8]{1,0} all-reduce-done((f32[16,8]{1,0}, u32[]) %ars)
+  %rs = bf16[4,4]{1,0} reduce-scatter(bf16[32,4]{1,0} %y), dimensions={0}
+"""
+    ops = hlo_collectives(hlo)
+    assert [(o.op, o.result_shapes) for o in ops] == [
+        ("all-gather", ((16, 64),)),
+        ("all-reduce", ((16, 8), ())),
+        ("reduce-scatter", ((4, 4),)),
+    ]
+    # f32[16,64] = 4096 B; f32[16,8] + u32[] = 512 + 4; bf16[4,4] = 32.
+    assert [o.nbytes for o in ops] == [4096, 516, 32]
+
+
+def test_hlo_collectives_ignores_non_collective_ops():
+    hlo = "  %d = f32[16,64]{1,0} dot(f32[16,8]{1,0} %a, f32[8,64]{1,0} %b)\n"
+    assert hlo_collectives(hlo) == []
+
+
+def test_strategy_prediction_covers_exactly_the_state_leaves():
+    state = {"w": np.zeros((64, 256), np.float32), "b": np.zeros((256,))}
+    pred = StrategyPrediction.from_state(state)
+    assert pred.predicts((64, 256))
+    assert pred.predicts((256,))
+    assert not pred.predicts((16, 64))
+
+
+# --- the DLC511 golden program -----------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def golden():
+    """A miniature fsdp step pair: batch sharded over the mesh, first
+    kernel sharded over its columns.  Without a constraint on the hidden
+    activation, GSPMD resolves the propagation conflict by all-gathering
+    the BATCH (f32[16,64]) — data parallelism silently collapsed.  The
+    constrained variant earns only the predicted parameter gather."""
+    if jax.device_count() < 8:
+        pytest.skip("golden program needs the 8-device virtual mesh")
+    mesh = Mesh(np.array(jax.devices()[:8]), ("fsdp",))
+
+    def sh(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    x = jax.device_put(np.ones((16, 64), np.float32), sh("fsdp", None))
+    w1 = jax.device_put(np.ones((64, 256), np.float32), sh(None, "fsdp"))
+    w2 = jax.device_put(np.ones((256, 8), np.float32), sh(None, None))
+
+    def loss_missing_constraint(x, w1, w2):
+        h = jnp.tanh(x @ w1)
+        return jnp.sum((h @ w2) ** 2)
+
+    def loss_constrained(x, w1, w2):
+        h = jnp.tanh(x @ w1)
+        h = jax.lax.with_sharding_constraint(h, sh("fsdp", None))
+        return jnp.sum((h @ w2) ** 2)
+
+    bad = jax.jit(loss_missing_constraint).lower(x, w1, w2).compile()
+    good = jax.jit(loss_constrained).lower(x, w1, w2).compile()
+    prediction = StrategyPrediction(
+        leaf_shapes=frozenset({(64, 256), (256, 8)})
+    )
+    return bad, good, prediction
+
+
+def test_dlc511_catches_the_planted_batch_gather(golden):
+    bad, _, prediction = golden
+    program = CommsWatcher().watch("train_step", bad, prediction=prediction)
+    assert (16, 64) in program.unpredicted_gathers
+    violations = violations_for([program], budget=None, device_count=8)
+    assert [v.rule for v in violations] == [AUDIT_RULE_UNPREDICTED]
+    assert "16x64" in violations[0].message
+    assert "train_step" in violations[0].message
+    # Findings anchor on the audited step's file by default.
+    assert violations[0].path == str(AUDITED_FILE)
+
+
+def test_constrained_variant_gathers_only_what_fsdp_predicts(golden):
+    _, good, prediction = golden
+    program = CommsWatcher().watch("train_step", good, prediction=prediction)
+    assert program.unpredicted_gathers == ()
+    assert violations_for([program], budget=None, device_count=8) == []
+    # The parameter gather fsdp earns is still there — the sentinel
+    # excuses it, it does not pretend the program is collective-free.
+    assert program.by_op.get("all-gather", 0) >= 1
+
+
+# --- the DLC510 budget ratchet -----------------------------------------------
+
+
+def _program(name="train_step", count=8, nbytes=11544, peak=1000):
+    return ProgramComms(
+        name=name,
+        collective_count=count,
+        collective_bytes=nbytes,
+        peak_hbm_bytes=peak,
+        by_op={},
+        bytes_by_op={},
+        flops=None,
+        bytes_accessed=None,
+    )
+
+
+def _budget(count=8, nbytes=11544, device_count=8, name="train_step"):
+    return {
+        "device_count": device_count,
+        "programs": {
+            name: {
+                "collective_count": count,
+                "collective_bytes": nbytes,
+                "peak_hbm_bytes": 1000,
+            }
+        },
+    }
+
+
+def test_dlc510_fires_when_op_count_regresses():
+    violations = violations_for([_program(count=9)], _budget(), device_count=8)
+    assert [v.rule for v in violations] == [AUDIT_RULE_BUDGET]
+    assert "op count" in violations[0].message
+
+
+def test_dlc510_fires_when_bytes_regress():
+    violations = violations_for(
+        [_program(nbytes=11545)], _budget(), device_count=8
+    )
+    assert [v.rule for v in violations] == [AUDIT_RULE_BUDGET]
+    assert "bytes" in violations[0].message
+
+
+def test_dlc510_quiet_at_exactly_the_committed_budget():
+    assert violations_for([_program()], _budget(), device_count=8) == []
+
+
+def test_dlc510_skips_on_device_count_mismatch():
+    """A budget measured on 8 devices says nothing about a 4-device
+    run — comparison must skip, not false-positive."""
+    regressed = _program(count=99)
+    assert (
+        violations_for([regressed], _budget(device_count=4), device_count=8)
+        == []
+    )
+
+
+def test_dlc510_skips_programs_the_budget_never_committed():
+    violations = violations_for(
+        [_program(name="new_path", count=99)], _budget(), device_count=8
+    )
+    assert violations == []
+
+
+def test_budget_roundtrips_through_disk(tmp_path):
+    path = tmp_path / "comms_budget.json"
+    program = _program()
+    payload = write_budget([program], path, device_count=8)
+    loaded = load_budget(path)
+    assert loaded == payload
+    assert loaded["programs"]["train_step"] == program.budget
+    assert load_budget(tmp_path / "missing.json") is None
+
+
+# --- the real trainer --------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def real_comms_audit(tmp_path_factory):
+    """One audited run shared by the assertions below (the compile bill
+    is the expensive part, not the checks)."""
+    from deeplearning_cfn_tpu.obs import recorder
+
+    journal = tmp_path_factory.mktemp("comms") / "flight.jsonl"
+    recorder.configure(path=journal)
+    try:
+        report = run_comms_audit(k=2, journal=True, budget_path=None)
+    finally:
+        recorder.configure()
+    return report, journal
+
+
+def test_real_audit_budgets_every_program(real_comms_audit):
+    report, _ = real_comms_audit
+    budgets = {p.name: p.budget for p in report.programs}
+    assert set(budgets) == {"train_step", "multi_step", "serve_decode"}
+    for name, budget in budgets.items():
+        assert budget["peak_hbm_bytes"] > 0, name
+        for value in budget.values():
+            assert value >= 0
+    # The fsdp train step must actually communicate on an 8-way mesh.
+    if report.device_count == 8:
+        assert budgets["train_step"]["collective_count"] > 0
+        assert budgets["train_step"]["collective_bytes"] > 0
+
+
+def test_real_audit_matches_the_committed_budget(real_comms_audit):
+    """The exact-match ratchet: same source, same HLO, same numbers.
+    A drift here means the committed budget was not regenerated after a
+    change to the trainer or audit model."""
+    report, _ = real_comms_audit
+    committed = load_budget()
+    if committed is None or int(committed["device_count"]) != report.device_count:
+        pytest.skip("no committed budget for this device count")
+    measured = {p.name: p.budget for p in report.programs}
+    assert measured == committed["programs"]
+
+
+def test_real_audit_findings_are_all_captured_in_the_baseline(real_comms_audit):
+    """The repo's own hot path carries known DLC511 findings (the tiny
+    audit model's batch gathers) — ratcheted into the committed
+    baseline, so the sentinel must report nothing FRESH."""
+    from deeplearning_cfn_tpu.analysis.runner import apply_audit_baseline
+
+    report, _ = real_comms_audit
+    assert all(v.rule in AUDIT_RULE_IDS for v in report.violations)
+    fresh, _stale = apply_audit_baseline(
+        report.violations, None, AUDIT_RULE_IDS
+    )
+    assert fresh == [], [v.to_dict() for v in fresh]
+
+
+def test_real_audit_journals_to_the_flight_recorder(real_comms_audit):
+    from deeplearning_cfn_tpu.obs.recorder import read_journal
+
+    report, journal = real_comms_audit
+    events = list(read_journal(journal, kind="comms_audit"))
+    assert len(events) == 1
+    event = events[0]
+    assert set(event["programs"]) == {"train_step", "multi_step", "serve_decode"}
+    assert event["device_count"] == report.device_count
+    for program in event["programs"].values():
+        assert {"collective_count", "collective_bytes", "peak_hbm_bytes"} <= set(
+            program
+        )

@@ -21,6 +21,14 @@ def test_repo_lints_clean_with_sharding_gate():
     assert not violations, "\n" + render_text(violations)
 
 
+def test_repo_lints_clean_with_comms_gate():
+    """Acceptance criterion of the DLC5xx pass: the comms tree carries
+    zero unsuppressed static comms findings (dynamic DLC51x findings
+    live in the sentinel's baseline, not here)."""
+    violations = run_lint(comms=True)
+    assert not violations, "\n" + render_text(violations)
+
+
 def test_cli_lint_exits_zero(capsys):
     from deeplearning_cfn_tpu.cli import main
 

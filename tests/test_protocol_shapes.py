@@ -220,9 +220,17 @@ def test_baseline_reports_stale_entries(tmp_path):
     ]
 
 
-def test_committed_baseline_is_empty():
-    """The ratchet's floor: the repo carries zero suppressed findings."""
-    assert runner.load_baseline(runner.DEFAULT_BASELINE) == set()
+def test_committed_baseline_carries_only_the_comms_sentinel_debt():
+    """The ratchet's floor: every STATIC namespace carries zero
+    suppressed findings.  The one accepted debt is the comms-audit
+    sentinel's DLC511 entries — the tiny audit model's known batch
+    gathers on the fsdp train path, ratcheted deliberately (see
+    docs/STATIC_ANALYSIS.md, "reading a comms report")."""
+    entries = runner.load_baseline(runner.DEFAULT_BASELINE)
+    assert {rule for rule, _, _ in entries} == {"DLC511"}
+    assert {path for _, path, _ in entries} == {
+        "deeplearning_cfn_tpu/train/trainer.py"
+    }
 
 
 # --- runner gating ------------------------------------------------------------
