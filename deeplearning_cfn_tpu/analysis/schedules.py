@@ -58,10 +58,17 @@ class SimBroker:
     def __init__(self, clock: VirtualClock):
         self._clock = clock
         self._beats: dict[str, tuple[float, int]] = {}
+        self._telem: dict[str, tuple[float, int, bytes]] = {}
 
     def record(self, worker: str) -> int:
         last, count = self._beats.get(worker, (0.0, 0))
         self._beats[worker] = (self._clock.now(), count + 1)
+        return count + 1
+
+    def record_telem(self, worker: str, payload: bytes) -> int:
+        """The TELEM <worker> verb: last-write-wins snapshot + count."""
+        _last, count, _old = self._telem.get(worker, (0.0, 0, b""))
+        self._telem[worker] = (self._clock.now(), count + 1, payload)
         return count + 1
 
     def dump(self) -> dict[str, tuple[float, int]]:
@@ -69,6 +76,14 @@ class SimBroker:
         return {
             worker: (now - last, count)
             for worker, (last, count) in self._beats.items()
+        }
+
+    def dump_telem(self) -> dict[str, tuple[float, int, bytes]]:
+        """The TELEM dump mode: worker -> (age_s, count, snapshot)."""
+        now = self._clock.now()
+        return {
+            worker: (now - last, count, payload)
+            for worker, (last, count, payload) in self._telem.items()
         }
 
     def silence_s(self, worker: str) -> float | None:
@@ -111,6 +126,13 @@ class SimBrokerConnection:
             self._fail_beats -= 1
             raise SimBrokerError("injected beat failure")
         return self._broker.record(worker_id)
+
+    def telem(self, worker_id: str, snapshot: bytes) -> int:
+        if self.closed:
+            raise SimBrokerError("connection is closed")
+        if self._fail_when is not None and self._fail_when():
+            raise SimBrokerError("network partition")
+        return self._broker.record_telem(worker_id, snapshot)
 
     def close(self) -> None:
         self.closed = True
@@ -434,6 +456,20 @@ class SimBrokerNode(SimBroker):
         )
         return count
 
+    def record_telem(self, worker: str, payload: bytes) -> int:
+        self._gate_write()
+        count = super().record_telem(worker, payload)
+        self._journal_frame(
+            {
+                "verb": "TELEM",
+                "worker": worker,
+                "ts": self._telem[worker][0],
+                "count": count,
+                "payload": payload,
+            }
+        )
+        return count
+
     def send_idempotent(self, queue: str, body: bytes, rid: str) -> str:
         self._gate_write()
         if self._apply_send(queue, body, rid):
@@ -455,6 +491,11 @@ class SimBrokerNode(SimBroker):
         if not self.up:
             raise SimBrokerError("closed connection")
         return super().dump()
+
+    def dump_telem(self) -> dict[str, tuple[float, int, bytes]]:
+        if not self.up:
+            raise SimBrokerError("closed connection")
+        return super().dump_telem()
 
     def depth(self, queue: str) -> int:
         if not self.up:
@@ -478,6 +519,12 @@ class SimBrokerNode(SimBroker):
             self.kv[frame["key"]] = frame["value"]
         elif verb == "HEARTBEAT":
             self._beats[frame["worker"]] = (frame["ts"], frame["count"])
+        elif verb == "TELEM":
+            self._telem[frame["worker"]] = (
+                frame["ts"],
+                frame["count"],
+                frame["payload"],
+            )
         else:
             raise ValueError(f"unknown replication verb {verb!r}")
 
@@ -557,6 +604,12 @@ class ReplicatedSimBroker:
         node = self.active()
         return node.dump() if node is not None else {}
 
+    def active_dump_telem(self) -> dict[str, tuple[float, int, bytes]]:
+        """The telemetry table a fleet aggregator would fetch: from the
+        live primary, or empty while no node serves (broker outage)."""
+        node = self.active()
+        return node.dump_telem() if node is not None else {}
+
     def pending(self, src: SimBrokerNode | None = None) -> list[dict]:
         """Journal entries the standby has not applied, oldest first."""
         src = src or self.primary
@@ -633,6 +686,9 @@ class FailoverSimConnection:
 
     def heartbeat(self, worker_id: str) -> int:
         return self._call(lambda node: node.record(worker_id))
+
+    def telem(self, worker_id: str, snapshot: bytes) -> int:
+        return self._call(lambda node: node.record_telem(worker_id, snapshot))
 
     def send_idempotent(self, queue: str, body: bytes, rid: str) -> str:
         return self._call(lambda node: node.send_idempotent(queue, body, rid))

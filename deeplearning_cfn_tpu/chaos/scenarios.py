@@ -28,6 +28,11 @@ Catalog:
   events and zero duplicate side effects (idempotent replay + re-send).
 * ``split-brain``  — a partition isolates the primary; epoch fencing
   rejects every stale-leader write and the deposed node stands down.
+* ``alert-storm``  — ~200 agents ship TELEM snapshots on their beats
+  while the shipped SLO rules evaluate the fleet merge: silent deaths
+  and stragglers each fire exactly once, firing alerts hold (no flap)
+  through a broker failover whose telemetry loss is bounded by the
+  unshipped journal tail, and healing resolves each alert exactly once.
 * ``slice-loss-live`` — a whole slice dies mid-run under a REAL 2-slice
   SPMD trainer (8 virtual CPU devices): the debounced terminate burst
   must trigger exactly one live reshard onto the survivors with zero
@@ -1492,6 +1497,293 @@ def split_brain(seed: int) -> ScenarioReport:
     return report
 
 
+# --- alert-storm -------------------------------------------------------------
+
+
+def alert_storm(seed: int) -> ScenarioReport:
+    """The full telemetry plane under a correlated incident: ~200 agents
+    piggyback TELEM snapshots on their heartbeats at a replicated sim
+    broker while the SHIPPED SLO rules (obs/slo.DEFAULT_RULES) evaluate
+    the fleet merge every round on virtual time.
+
+    Storyline: a seeded subset dies silently (dead-fraction must fire
+    exactly once, after its for-window), a second subset turns straggler
+    (step-time p99 must fire exactly once), the primary broker dies with
+    an unshipped telemetry tail mid-storm (firing alerts must HOLD
+    through the one-round blackout — no flapping — and telemetry loss is
+    bounded by the tail), the fleet heals (both alerts resolve exactly
+    once), and a quiet drain proves no further transitions.  Alert
+    transitions are journaled as kind "alert" and published as
+    EventKind.ALERT; the terminate events also trigger a blackbox
+    capture, tying the postmortem path into the same storm.
+    """
+    import random as _random
+
+    from deeplearning_cfn_tpu.analysis.schedules import (
+        FailoverSimConnection,
+        ReplicatedSimBroker,
+        VirtualClock,
+    )
+    from deeplearning_cfn_tpu.cluster.broker_service import (
+        BrokerLivenessWatcher,
+    )
+    from deeplearning_cfn_tpu.obs.aggregator import (
+        FleetAggregator,
+        fleet_metric_values,
+    )
+    from deeplearning_cfn_tpu.obs.blackbox import BlackBox
+    from deeplearning_cfn_tpu.obs.heartbeat import Heartbeater
+    from deeplearning_cfn_tpu.obs.liveness import LivenessConfig
+    from deeplearning_cfn_tpu.obs.recorder import FlightRecorder
+    from deeplearning_cfn_tpu.obs.slo import DEFAULT_RULES, SloEngine
+    from deeplearning_cfn_tpu.provision.events import EventBus, EventKind
+
+    report = ScenarioReport("alert-storm", seed)
+    rng = _random.Random(seed)
+    tick_s = 5.0
+    agents = 200
+    kill_count = 30  # 15% dead > the 10% rule threshold
+    straggler_count = 20
+    unshipped_tail = 57
+
+    clock = VirtualClock()
+    cluster = ReplicatedSimBroker(clock)
+    cfg = LivenessConfig(suspect_after_s=15.0, dead_after_s=60.0)
+    bus = EventBus()
+    recorder = FlightRecorder()  # in-memory ring, no journal file
+    alerts_on_bus: list[tuple[str, str]] = []
+    terminates: list[str] = []
+
+    def on_event(event) -> None:
+        if event.kind is EventKind.ALERT:
+            alerts_on_bus.append(
+                (event.detail.get("rule"), event.detail.get("state"))
+            )
+        elif event.kind is EventKind.INSTANCE_TERMINATE:
+            terminates.append(event.instance_id)
+
+    bus.subscribe(on_event)
+    watcher = BrokerLivenessWatcher(
+        cluster_name="sim-storm",
+        group="agents",
+        bus=bus,
+        config=cfg,
+        clock=clock,
+        fetch=cluster.active_dump,
+    )
+    engine = SloEngine(
+        DEFAULT_RULES, clock=clock.now, bus=bus, recorder=recorder
+    )
+    aggregator = FleetAggregator()
+
+    tmp = Path(tempfile.mkdtemp(prefix="dlcfn-storm-"))
+    blackbox = BlackBox(
+        tmp, host="sim-host", worker="agents", recorder=recorder, clock=clock.now
+    )
+    blackbox.attach(bus)
+
+    names = [f"agent-{i:03d}" for i in range(agents)]
+    # Per-agent mutable profile the telemetry closure reads each beat:
+    # the straggler phase flips "ms", the heal phase flips it back.
+    profiles = {w: {"ms": 100.0} for w in names}
+
+    def make_source(worker: str):
+        def source() -> dict:
+            return {
+                "v": 1,
+                "gauges": {"dlcfn_serve_queue_depth": 1.0},
+                "summaries": {"dlcfn_step_ms": [profiles[worker]["ms"]] * 8},
+            }
+
+        return source
+
+    beaters = {
+        w: Heartbeater(
+            host="sim",
+            port=0,
+            worker_id=w,
+            interval_s=tick_s,
+            connection_factory=lambda: FailoverSimConnection(cluster.nodes()),
+            telemetry_source=make_source(w),
+        )
+        for w in names
+    }
+    alive = set(names)
+    transitions: list[dict] = []
+
+    def round_(stream: bool = True) -> list[dict]:
+        for w in names:
+            if w in alive:
+                beaters[w].beat_step()
+        if stream and cluster.active() is cluster.primary:
+            cluster.stream()
+        clock.advance(tick_s)
+        watcher.poll()
+        merged = aggregator.merge(
+            cluster.active_dump_telem(), liveness=watcher.snapshot()
+        )
+        new = engine.evaluate(fleet_metric_values(merged))
+        transitions.extend(new)
+        return new
+
+    try:
+        # Phase 1 — warmup: healthy fleet, replication caught up, quiet.
+        for _ in range(4):
+            round_()
+        report.check(
+            not transitions, "warmup: healthy fleet raised no alerts"
+        )
+
+        # Phase 2 — silent death: the dead-fraction rule must fire once,
+        # only after classification (dead_after_s) plus its for-window.
+        alive -= set(rng.sample(names, kill_count))
+        for _ in range(22):
+            round_()
+        dead_state = engine.snapshot()["worker-dead-fraction"]
+        report.check(
+            dead_state["firing"] and dead_state["fired_count"] == 1,
+            "dead-fraction alert fired exactly once for the silent deaths",
+        )
+        report.check(
+            len(set(terminates)) == kill_count
+            and blackbox.captures == len(terminates),
+            "every dead agent terminated once and each terminate "
+            "triggered a blackbox capture",
+        )
+
+        # Phase 3 — stragglers: slow step samples push the fleet p99
+        # over the shipped threshold; fires once after its for-window.
+        for w in rng.sample(sorted(alive), straggler_count):
+            profiles[w]["ms"] = 4000.0
+        for _ in range(15):
+            round_()
+        strag_state = engine.snapshot()["step-time-p99-straggler"]
+        report.check(
+            strag_state["firing"] and strag_state["fired_count"] == 1,
+            "step-time p99 straggler alert fired exactly once",
+        )
+
+        # Phase 4 — broker failover mid-storm with an unshipped tail.
+        before = len(transitions)
+        for w in names:
+            if w in alive:
+                beaters[w].beat_step()
+        # Ground truth at the instant of death: the primary's post-beat
+        # table — whatever the standby lacks of THIS is the real loss.
+        pre_counts = {
+            w: c for w, (_a, c, _p) in cluster.primary.dump_telem().items()
+        }
+        backlog = len(cluster.pending())
+        cluster.stream(max_entries=max(0, backlog - unshipped_tail))
+        lag_at_kill = len(cluster.pending())
+        cluster.kill_primary()
+        clock.advance(tick_s)
+        watcher.poll()  # outage round: empty fetch, firing alerts HOLD
+        merged = aggregator.merge(
+            cluster.active_dump_telem(), liveness=watcher.snapshot()
+        )
+        transitions.extend(engine.evaluate(fleet_metric_values(merged)))
+        epoch = cluster.promote_standby()
+        post_telem = cluster.standby.dump_telem()
+        post_counts = {w: c for w, (_a, c, _p) in post_telem.items()}
+        lost_snapshots = sum(
+            pre_counts[w] - post_counts.get(w, 0) for w in pre_counts
+        )
+        report.check(
+            lag_at_kill == unshipped_tail and 0 < lost_snapshots <= unshipped_tail,
+            f"telemetry loss across failover bounded by the unshipped "
+            f"journal tail ({lost_snapshots} <= {unshipped_tail} frames)",
+        )
+        round_()  # first round on the new primary: agents fail over
+        report.check(
+            alive <= set(cluster.standby.dump_telem()),
+            "one beat round after promotion every live agent's snapshot "
+            "is back on the new primary (telemetry self-heals)",
+        )
+        report.check(
+            len(transitions) == before
+            and engine.snapshot()["worker-dead-fraction"]["firing"]
+            and engine.snapshot()["step-time-p99-straggler"]["firing"],
+            "no alert flapped through the failover blackout: both "
+            "incidents held firing, zero transitions",
+        )
+
+        # Phase 5 — heal: dead agents resurrect, stragglers normalize;
+        # each alert resolves exactly once.
+        alive = set(names)
+        for profile in profiles.values():
+            profile["ms"] = 100.0
+        for _ in range(4):
+            round_()
+        snap = engine.snapshot()
+        report.check(
+            not snap["worker-dead-fraction"]["firing"]
+            and snap["worker-dead-fraction"]["resolved_count"] == 1,
+            "dead-fraction alert resolved exactly once on heal",
+        )
+        report.check(
+            not snap["step-time-p99-straggler"]["firing"]
+            and snap["step-time-p99-straggler"]["resolved_count"] == 1,
+            "straggler alert resolved exactly once on heal",
+        )
+
+        # Phase 6 — drain: a quiet fleet must stay quiet.
+        quiet_before = len(transitions)
+        for _ in range(6):
+            round_()
+        report.check(
+            len(transitions) == quiet_before,
+            "drain: no flapping after recovery",
+        )
+
+        snap = engine.snapshot()
+        report.check(
+            all(s["fired_count"] == s["resolved_count"] for s in snap.values())
+            and sum(s["fired_count"] for s in snap.values()) == 2,
+            "exactly two incidents fleet-wide; every fire has one resolve",
+        )
+        journaled = [
+            e for e in recorder.tail(4096) if e.get("kind") == "alert"
+        ]
+        report.check(
+            len(journaled) == len(transitions) == len(alerts_on_bus) == 4,
+            "every transition journaled as kind 'alert' and published as "
+            "EventKind.ALERT (4 of 4)",
+        )
+        report.check(
+            [t["rule"] for t in transitions]
+            == [r for r, _s in alerts_on_bus],
+            "journal and bus agree on transition order",
+        )
+        final = aggregator.merge(
+            cluster.active_dump_telem(), liveness=watcher.snapshot()
+        )
+        report.check(
+            final["hosts"] == agents and final["dead_fraction"] == 0.0,
+            "final fleet merge sees every agent fresh and alive",
+        )
+        report.details.update(
+            agents=agents,
+            killed=kill_count,
+            stragglers=straggler_count,
+            epoch=epoch,
+            unshipped_at_kill=lag_at_kill,
+            lost_snapshots=lost_snapshots,
+            transitions=[(t["rule"], t["state"]) for t in transitions],
+            terminates=len(terminates),
+            blackbox_captures=blackbox.captures,
+            fleet_gauge_sum=final["gauges"]
+            .get("dlcfn_serve_queue_depth", {})
+            .get("sum"),
+            step_p99_final=final["summaries"]
+            .get("dlcfn_step_ms", {})
+            .get("p99"),
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return report
+
+
 SCENARIOS: dict[str, Callable[[int], ScenarioReport]] = {
     "silent-death": silent_death,
     "partition": partition,
@@ -1502,6 +1794,7 @@ SCENARIOS: dict[str, Callable[[int], ScenarioReport]] = {
     "serve-replica-loss": serve_replica_loss,
     "broker-failover": broker_failover,
     "split-brain": split_brain,
+    "alert-storm": alert_storm,
 }
 
 

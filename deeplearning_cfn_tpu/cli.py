@@ -758,6 +758,48 @@ def _status_comms(args) -> dict | None:
     return dict(sorted(folded.items())) or None
 
 
+def _status_fleet(args, liveness) -> dict | None:
+    """Fleet-merged agent telemetry from the broker's TELEM table, or
+    None (``--fleet`` not passed / no broker source / dial failure).
+
+    Snapshots are whatever each agent's Heartbeater piggybacked on its
+    last beat; the merge (obs/aggregator.FleetAggregator) folds gauges
+    as sum/max/last-per-worker and summaries as fleet-wide quantiles
+    over the concatenated samples.  ``liveness`` (already computed for
+    the status view) contributes the dead-fraction the SLO rules watch."""
+    if not getattr(args, "fleet", False):
+        return None
+    from deeplearning_cfn_tpu.cluster.broker_client import (
+        BrokerConnection,
+        BrokerError,
+    )
+
+    if args.status_broker:
+        host, port = _parse_broker(args.status_broker)
+    elif args.cluster:
+        from deeplearning_cfn_tpu.cluster.broker_service import broker_status
+
+        record = broker_status(args.cluster)
+        if record is None or not record.get("alive"):
+            return None
+        # Loopback, same rationale as the liveness probe: the recorded
+        # host may be a NAT address not locally routable.
+        host, port = "127.0.0.1", int(record["port"])
+    else:
+        raise SystemExit("dlcfn status --fleet needs --broker or --cluster")
+    try:
+        conn = BrokerConnection(host, port)
+        try:
+            table = conn.telemetry()
+        finally:
+            conn.close()
+    except (OSError, BrokerError):
+        return None
+    from deeplearning_cfn_tpu.obs.aggregator import FleetAggregator
+
+    return FleetAggregator().merge(table, liveness=liveness)
+
+
 def _status_mesh(args) -> dict | None:
     """The current mesh shape straight from the published cluster
     contract (slices/workers/chips and the degraded flag) — after a live
@@ -849,6 +891,7 @@ def cmd_status(args) -> int:
     profile = _status_profile(args)
     serve = _status_serve(args)
     comms = _status_comms(args)
+    fleet = _status_fleet(args, liveness)
     workers = _status_metrics(args.metrics_dir) if args.metrics_dir else None
     if args.metrics_dir and workers is None:
         print(f"no metrics under {args.metrics_dir}", file=sys.stderr)
@@ -868,6 +911,7 @@ def cmd_status(args) -> int:
                 serve=serve,
                 broker=broker,
                 comms=comms,
+                fleet=fleet,
             ),
             end="",
         )
@@ -882,6 +926,7 @@ def cmd_status(args) -> int:
         and profile is None
         and serve is None
         and comms is None
+        and fleet is None
     ):
         # Metrics-only: the original (round-4) output shape, unchanged.
         print(json.dumps(workers, indent=2))
@@ -905,6 +950,8 @@ def cmd_status(args) -> int:
         out["serve"] = serve
     if comms is not None:
         out["comms"] = comms
+    if fleet is not None:
+        out["fleet"] = fleet
     if workers is not None:
         out["workers"] = workers
     print(json.dumps(out, indent=2))
@@ -992,6 +1039,52 @@ def cmd_trace(args) -> int:
     if stragglers["steps"]:
         summary["stragglers"] = stragglers
     print(json.dumps(summary, indent=2, default=str), file=sys.stderr)
+    return 0
+
+
+def cmd_postmortem(args) -> int:
+    """Merge per-host blackbox bundles into one causal timeline.
+
+    Bundles are what obs/blackbox.py captured at each host's death
+    (journal tail, profiler state, config, budgets); clocks are aligned
+    with the heartbeat pairs inside the bundles' journals, ties break
+    deterministically by (host, seq), and SLO alert transitions are
+    overlaid so "what fired" reads next to "what happened"."""
+    from deeplearning_cfn_tpu.obs.blackbox import (
+        merge_bundles,
+        read_bundle,
+        render_timeline,
+    )
+
+    paths: list[Path] = []
+    for raw in args.bundle or []:
+        p = Path(raw)
+        if p.is_dir():
+            paths.extend(sorted(p.glob("blackbox-*.json")))
+        else:
+            paths.append(p)
+    if not paths:
+        raise SystemExit(
+            "dlcfn postmortem needs bundle files or a directory of "
+            "blackbox-*.json captures"
+        )
+    missing = [str(p) for p in paths if not p.exists()]
+    if missing:
+        print(f"no bundle at {', '.join(missing)}", file=sys.stderr)
+        return 1
+    bundles = []
+    for p in paths:
+        try:
+            bundles.append(read_bundle(p))
+        except (ValueError, OSError) as e:
+            print(f"skipping unreadable bundle {p}: {e}", file=sys.stderr)
+    if not bundles:
+        return 1
+    merged = merge_bundles(bundles)
+    if args.format == "json":
+        print(json.dumps(merged, indent=2, default=str))
+    else:
+        print(render_timeline(merged, last_n=args.last or None), end="")
     return 0
 
 
@@ -1496,6 +1589,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="with --journal: per-replica serving snapshots "
                          "(slots, queue depth, TTFT quantiles, tokens/s) "
                          "folded from serve_metrics events")
+    ps.add_argument("--fleet", action="store_true",
+                    help="with --broker/--cluster: fleet-merged agent "
+                         "telemetry from the broker's TELEM table (gauge "
+                         "sum/max/last per worker, fleet-wide summary "
+                         "quantiles, dead fraction)")
     ps.set_defaults(fn=cmd_status)
     # events tails the flight recorder's journal.
     pe = sub.add_parser("events", help="tail the obs flight journal")
@@ -1550,6 +1648,19 @@ def main(argv: list[str] | None = None) -> int:
     pv.add_argument("--journal", default=None,
                     help="flight journal path for serve_metrics events")
     pv.set_defaults(fn=cmd_serve)
+    pm = sub.add_parser(
+        "postmortem",
+        help="merge blackbox bundles into one causal cross-host timeline",
+    )
+    pm.add_argument("bundle", nargs="*", metavar="PATH",
+                    help="bundle file (blackbox-<host>.json) or a directory "
+                         "of them; repeat once per host")
+    pm.add_argument("--format", choices=["text", "json"], default="text",
+                    help="text = aligned timeline with alerts overlaid; "
+                         "json = the full merged structure")
+    pm.add_argument("-n", "--last", type=int, default=0, dest="last",
+                    help="only the last N timeline events (0 = all)")
+    pm.set_defaults(fn=cmd_postmortem)
     px = sub.add_parser(
         "chaos", help="run seeded fault-injection scenarios (resilience soak)"
     )
@@ -1557,7 +1668,7 @@ def main(argv: list[str] | None = None) -> int:
                     help="scenario name (see --list): silent-death, "
                          "partition, flaky-rpc, slow-disk, slice-loss-live, "
                          "straggler, serve-replica-loss, broker-failover, "
-                         "split-brain")
+                         "split-brain, alert-storm")
     px.add_argument("--seed", type=int, default=0,
                     help="fault-schedule seed; reports are deterministic "
                          "per (scenario, seed)")

@@ -50,6 +50,8 @@ from deeplearning_cfn_tpu.cluster.bootstrap import (
 )
 from deeplearning_cfn_tpu.cluster.broker_backend import BrokerAgentBackend
 from deeplearning_cfn_tpu.cluster.broker_client import BrokerError
+from deeplearning_cfn_tpu.obs.aggregator import telemetry_source
+from deeplearning_cfn_tpu.obs.blackbox import BlackBox
 from deeplearning_cfn_tpu.obs.heartbeat import Heartbeater
 from deeplearning_cfn_tpu.obs.recorder import get_recorder
 from deeplearning_cfn_tpu.provision.backend import ResourceSignal
@@ -119,9 +121,36 @@ def main() -> int:
     # reachable until the agent exits.  The supervisor's liveness watcher
     # (broker_service.BrokerLivenessWatcher) turns sustained silence into
     # an INSTANCE_TERMINATE — so a VM that wedges after connect is
-    # detected even though it never reports an error.
-    heartbeater = Heartbeater(host, int(port), worker_id=f"{my_group}/{index}")
+    # detected even though it never reports an error.  Every beat also
+    # piggybacks a TELEM snapshot (obs/aggregator.py) so the controller's
+    # fleet merge and SLO rules see this host without any extra dial.
+    worker_id = f"{my_group}/{index}"
+    heartbeater = Heartbeater(
+        host,
+        int(port),
+        worker_id=worker_id,
+        telemetry_source=telemetry_source(
+            worker_id,
+            gauges=lambda: {"dlcfn_mesh_workers": 1.0},
+        ),
+    )
     heartbeater.start()
+
+    # Crash blackbox: freeze the journal tail + resolved identity on a
+    # fatal bootstrap error so `dlcfn postmortem` can reconstruct the
+    # cross-host timeline even when this VM is reaped seconds later.
+    blackbox = BlackBox(
+        out_dir=os.environ.get("DLCFN_BLACKBOX_DIR", "/tmp/dlcfn-blackbox"),
+        host=os.environ.get("DLCFN_WORKER") or worker_id.replace("/", "-"),
+        worker=worker_id,
+        config={
+            "cluster": cluster,
+            "group": my_group,
+            "index": index,
+            "role": role,
+            "broker": broker,
+        },
+    )
 
     agent = BootstrapAgent(
         backend=backend,
@@ -155,6 +184,10 @@ def main() -> int:
             )
     except (BootstrapError, BudgetExhausted) as e:
         log.error("bootstrap failed: %s", e)
+        try:
+            blackbox.capture(f"bootstrap-failed: {e}")
+        except OSError:
+            log.error("blackbox capture failed (disk?)")
         if role == "coordinator":
             # Fail the WaitCondition NOW so the controller rolls back within
             # one poll tick instead of burning the full cluster_ready budget

@@ -294,6 +294,39 @@ class BrokerConnection:
             out[worker] = (int(age_ms) / 1000.0, int(count))
         return out
 
+    # --- fleet telemetry (obs plane) --------------------------------------
+    @_traced
+    def telem(self, worker_id: str, snapshot: bytes) -> int:
+        """Record ``worker_id``'s latest telemetry snapshot (last-write-
+        wins, like a beat with a payload); returns its snapshot count."""
+        if not worker_id or any(c.isspace() for c in worker_id):
+            raise BrokerError(f"bad telemetry worker id: {worker_id!r}")
+        self.sock.sendall(
+            f"TELEM {worker_id} {len(snapshot)}\n".encode() + snapshot
+        )
+        resp = self._read_line()
+        if not resp.startswith("OK "):
+            raise BrokerError(f"TELEM failed: {resp}")
+        return int(resp[3:])
+
+    @_traced
+    def telemetry(self) -> dict[str, tuple[float, int, bytes]]:
+        """Dump the broker's telemetry table: worker ->
+        (age_s, snapshot count, latest snapshot bytes)."""
+        self.sock.sendall(b"TELEM\n")
+        header = self._read_line()
+        if not header.startswith("N "):
+            raise BrokerError(f"TELEM dump failed: {header}")
+        out: dict[str, tuple[float, int, bytes]] = {}
+        for _ in range(int(header[2:])):
+            tline = self._read_line().split(" ")
+            if tline[0] != "TM" or len(tline) != 5:
+                raise BrokerError(f"bad TM frame: {tline}")
+            _, worker, age_ms, count, length = tline
+            payload = self._read_exact(int(length))
+            out[worker] = (int(age_ms) / 1000.0, int(count), payload)
+        return out
+
     # --- replication / leader handover (docs/RESILIENCE.md) --------------
     @_traced
     def send_idempotent(self, queue: str, body: bytes, rid: str) -> str:
@@ -542,6 +575,12 @@ class FailoverBrokerConnection:
 
     def heartbeats(self) -> dict[str, tuple[float, int]]:
         return self._call("heartbeats", lambda c: c.heartbeats())
+
+    def telem(self, worker_id: str, snapshot: bytes) -> int:
+        return self._call("telem", lambda c: c.telem(worker_id, snapshot))
+
+    def telemetry(self) -> dict[str, tuple[float, int, bytes]]:
+        return self._call("telemetry", lambda c: c.telemetry())
 
     def role(self) -> tuple[str, int, int]:
         return self._call("role", lambda c: c.role())

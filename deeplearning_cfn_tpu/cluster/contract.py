@@ -51,6 +51,9 @@ BROKER_PROTOCOL_VERBS = (
     # HEARTBEAT <worker>                         record a liveness beat
     # HEARTBEAT                                  dump table: N <n> then HB lines
     "HEARTBEAT",
+    # TELEM <worker> <nbytes>\n<snapshot>        record a telemetry snapshot
+    # TELEM                                      dump snapshots: N <n> then TM frames
+    "TELEM",
     # -- replication / leader handover (docs/RESILIENCE.md "Broker
     #    failover"): a warm standby replays the primary's journal and is
     #    promoted with a higher epoch; epoch fencing rejects the deposed

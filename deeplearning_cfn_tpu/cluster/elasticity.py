@@ -150,6 +150,14 @@ class ElasticityController:
             self._on_terminate(policy, event)
         elif event.kind is EventKind.TEST_NOTIFICATION:
             log.info("test notification for group %s", event.group)
+        elif event.kind is EventKind.ALERT:
+            # SLO alerts (obs/slo.py) share the bus but carry no capacity
+            # intent; the controller only surfaces them.  Autoscale-on-alert
+            # is ROADMAP item 3 and would hook in here.
+            log.info(
+                "alert %s for group %s: %s",
+                event.detail.get("state", "?"), event.group, event.detail,
+            )
 
     # --- helpers ---------------------------------------------------------
     def _counts(self, name: str) -> tuple[int, int]:

@@ -35,6 +35,23 @@ from deeplearning_cfn_tpu.obs.trace_export import (
     merge_journals,
     straggler_table,
 )
+from deeplearning_cfn_tpu.obs.aggregator import (
+    FleetAggregator,
+    agent_snapshot,
+    decode_snapshot,
+    encode_snapshot,
+    fleet_metric_values,
+    telemetry_source,
+)
+from deeplearning_cfn_tpu.obs.slo import DEFAULT_RULES, SloEngine, SloRule
+from deeplearning_cfn_tpu.obs.blackbox import (
+    BlackBox,
+    capture_bundle,
+    merge_bundles,
+    read_bundle,
+    render_timeline,
+    write_bundle,
+)
 
 __all__ = [
     "FlightRecorder",
@@ -57,4 +74,19 @@ __all__ = [
     "chrome_trace",
     "merge_journals",
     "straggler_table",
+    "FleetAggregator",
+    "agent_snapshot",
+    "decode_snapshot",
+    "encode_snapshot",
+    "fleet_metric_values",
+    "telemetry_source",
+    "DEFAULT_RULES",
+    "SloEngine",
+    "SloRule",
+    "BlackBox",
+    "capture_bundle",
+    "merge_bundles",
+    "read_bundle",
+    "render_timeline",
+    "write_bundle",
 ]

@@ -4,11 +4,72 @@
 the text format (``# HELP`` / ``# TYPE`` then ``name{labels} value``)
 so a node-exporter textfile collector or a curl-into-pushgateway cron
 can scrape it without a client library.
+
+Every family this module may ever emit is declared once in
+:data:`METRIC_REGISTRY` — name, type, help — and headers are rendered
+exclusively from it through a per-render seen-set, so two folds that
+touch the same family can never produce duplicate ``# TYPE`` lines (a
+hard error in Prometheus ingesters).  The registry is also the
+authority SLO rules are validated against (scripts/check.sh rejects a
+rule referencing an unregistered name).
 """
 
 from __future__ import annotations
 
 from typing import Any, Mapping
+
+#: family name -> (prometheus type, help text).  Summary families list
+#: the base name only; their ``_sum`` / ``_count`` children inherit the
+#: header per the text format.
+METRIC_REGISTRY: dict[str, tuple[str, str]] = {
+    # liveness
+    "dlcfn_worker_up": ("gauge", "1 while the worker's heartbeat is not DEAD."),
+    "dlcfn_heartbeat_age_seconds": ("gauge", "Seconds since the worker's last heartbeat."),
+    "dlcfn_heartbeats_total": ("counter", "Heartbeats observed from the worker."),
+    "dlcfn_worker_dead_fraction": ("gauge", "Fraction of tracked workers currently declared dead."),
+    # spans
+    "dlcfn_span_count": ("counter", "Completed spans by name."),
+    "dlcfn_span_seconds_total": ("counter", "Total wall seconds spent in spans."),
+    "dlcfn_span_seconds_max": ("gauge", "Longest single span by name."),
+    "dlcfn_span_seconds": ("summary", "Span duration quantiles over the journal window."),
+    # input pipeline
+    "dlcfn_input_pipeline_bytes_transferred": ("gauge", "Host->device bytes moved by the input pipeline."),
+    "dlcfn_input_pipeline_host_input_seconds": ("gauge", "Seconds producers spent in the source iterator."),
+    "dlcfn_input_pipeline_producer_stall_seconds": ("gauge", "Seconds producers blocked on a full buffer."),
+    "dlcfn_input_pipeline_consumer_wait_seconds": ("gauge", "Seconds the training loop waited for input."),
+    "dlcfn_input_pipeline_overlap_fraction": ("gauge", "Fraction of the run with input hidden behind compute."),
+    # elastic reshard
+    "dlcfn_reshard_total": ("counter", "Live elastic reshards completed."),
+    "dlcfn_reshard_seconds": ("gauge", "Total seconds spent pausing and resharding (injected clock)."),
+    "dlcfn_reshard_fallback_total": ("counter", "Reshards that degraded to the checkpoint/restore path."),
+    # mesh / contract
+    "dlcfn_mesh_slices": ("gauge", "Slices in the current cluster contract."),
+    "dlcfn_mesh_workers": ("gauge", "Worker hosts in the current cluster contract."),
+    "dlcfn_mesh_chips_total": ("gauge", "Total chips across the current mesh."),
+    # step profiler
+    "dlcfn_step_phase_ms": ("summary", "Step-phase duration quantiles (rolling window)."),
+    "dlcfn_step_ms": ("summary", "Whole-step duration quantiles (rolling window)."),
+    # serving
+    "dlcfn_serve_active_slots": ("gauge", "Decode slots currently occupied on the replica."),
+    "dlcfn_serve_queue_depth": ("gauge", "Requests admitted but not yet slotted."),
+    "dlcfn_serve_tokens_per_s": ("gauge", "Sampled tokens per second (replica lifetime)."),
+    "dlcfn_serve_ttft_ms": ("summary", "Time-to-first-token quantiles (replica lifetime)."),
+    # comms audit
+    "dlcfn_comms_collective_bytes": ("gauge", "Bytes moved by collectives per execution of the audited program."),
+    "dlcfn_comms_peak_hbm_bytes": ("gauge", "Peak-HBM estimate (args + outputs + temps - aliased) of the audited program."),
+    "dlcfn_comms_collective_count": ("gauge", "Collective ops (all-gather/all-reduce/...) in the audited program's HLO."),
+    # broker control plane
+    "dlcfn_broker_role": ("gauge", "Broker role per node (1 = primary, 0 = standby)."),
+    "dlcfn_broker_epoch": ("gauge", "Leadership term the node is fenced to."),
+    "dlcfn_broker_up": ("gauge", "1 while the node answers on loopback."),
+    "dlcfn_broker_replication_lag_seconds": ("gauge", "Age of the oldest journal entry the standby has not applied."),
+    "dlcfn_broker_replication_lag_entries": ("gauge", "Journal entries the standby has not applied."),
+    # fleet telemetry (TELEM plane, obs/aggregator.py)
+    "dlcfn_fleet_workers": ("gauge", "Workers with a fresh telemetry snapshot in the fleet merge."),
+    "dlcfn_fleet_telemetry_age_seconds": ("gauge", "Age of each worker's newest telemetry snapshot."),
+    "dlcfn_fleet_gauge": ("gauge", "Fleet-merged agent gauge (agg label: sum/max fleet-wide, last per worker)."),
+    "dlcfn_fleet_summary": ("summary", "Fleet-merged sample summaries (quantiles over all hosts' samples)."),
+}
 
 
 def _escape(value: str) -> str:
@@ -123,6 +184,7 @@ def render_prometheus(
     serve: Mapping[str, Mapping[str, Any]] | None = None,
     broker: Mapping[str, Any] | None = None,
     comms: Mapping[str, Mapping[str, Any]] | None = None,
+    fleet: Mapping[str, Any] | None = None,
 ) -> str:
     """Render liveness snapshot + span aggregates + input-pipeline
     counters as Prometheus text.
@@ -139,63 +201,55 @@ def render_prometheus(
     summaries; ``broker`` is
     ``broker_service.broker_replication_status()`` (role/epoch per node
     plus replication lag); ``comms`` is ``fold_comms_events()`` (the
-    comms-audit sentinel's per-program collective/HBM budgets).  Any may
-    be None/empty.
+    comms-audit sentinel's per-program collective/HBM budgets);
+    ``fleet`` is ``obs.aggregator.FleetAggregator.merge()`` (the TELEM
+    fleet merge).  Any may be None/empty.
     """
     lines: list[str] = []
+    seen: set[str] = set()
+
+    def head(name: str) -> None:
+        # One HELP/TYPE header per family per render, straight from the
+        # registry — folds can interleave without ever duplicating one.
+        if name in seen:
+            return
+        seen.add(name)
+        mtype, help_text = METRIC_REGISTRY[name]
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+
     if liveness:
-        lines += [
-            "# HELP dlcfn_worker_up 1 while the worker's heartbeat is not DEAD.",
-            "# TYPE dlcfn_worker_up gauge",
-        ]
+        head("dlcfn_worker_up")
         for worker, row in liveness.items():
             labels = _labels(cluster=cluster, worker=worker, state=row["state"])
             lines.append(
                 f"dlcfn_worker_up{labels} {0 if row['state'] == 'dead' else 1}"
             )
-        lines += [
-            "# HELP dlcfn_heartbeat_age_seconds Seconds since the worker's last heartbeat.",
-            "# TYPE dlcfn_heartbeat_age_seconds gauge",
-        ]
+        head("dlcfn_heartbeat_age_seconds")
         for worker, row in liveness.items():
             labels = _labels(cluster=cluster, worker=worker)
             lines.append(f"dlcfn_heartbeat_age_seconds{labels} {row['age_s']}")
-        lines += [
-            "# HELP dlcfn_heartbeats_total Heartbeats observed from the worker.",
-            "# TYPE dlcfn_heartbeats_total counter",
-        ]
+        head("dlcfn_heartbeats_total")
         for worker, row in liveness.items():
             labels = _labels(cluster=cluster, worker=worker)
             lines.append(f"dlcfn_heartbeats_total{labels} {row['beats']}")
     if spans:
-        lines += [
-            "# HELP dlcfn_span_count Completed spans by name.",
-            "# TYPE dlcfn_span_count counter",
-        ]
+        head("dlcfn_span_count")
         for name, agg in spans.items():
             lines.append(f"dlcfn_span_count{_labels(span=name)} {agg['count']}")
-        lines += [
-            "# HELP dlcfn_span_seconds_total Total wall seconds spent in spans.",
-            "# TYPE dlcfn_span_seconds_total counter",
-        ]
+        head("dlcfn_span_seconds_total")
         for name, agg in spans.items():
             lines.append(
                 f"dlcfn_span_seconds_total{_labels(span=name)} {agg['total_s']}"
             )
-        lines += [
-            "# HELP dlcfn_span_seconds_max Longest single span by name.",
-            "# TYPE dlcfn_span_seconds_max gauge",
-        ]
+        head("dlcfn_span_seconds_max")
         for name, agg in spans.items():
             lines.append(f"dlcfn_span_seconds_max{_labels(span=name)} {agg['max_s']}")
         quantiled = {
             name: agg for name, agg in spans.items() if "p50_s" in agg
         }
         if quantiled:
-            lines += [
-                "# HELP dlcfn_span_seconds Span duration quantiles over the journal window.",
-                "# TYPE dlcfn_span_seconds summary",
-            ]
+            head("dlcfn_span_seconds")
             for name, agg in quantiled.items():
                 for quantile, key in (
                     ("0.5", "p50_s"),
@@ -216,18 +270,14 @@ def render_prometheus(
                     f"dlcfn_span_seconds_count{_labels(span=name)} {agg['count']}"
                 )
     if pipeline:
-        gauges = (
-            ("bytes_transferred", "Host->device bytes moved by the input pipeline."),
-            ("host_input_seconds", "Seconds producers spent in the source iterator."),
-            ("producer_stall_seconds", "Seconds producers blocked on a full buffer."),
-            ("consumer_wait_seconds", "Seconds the training loop waited for input."),
-            ("overlap_fraction", "Fraction of the run with input hidden behind compute."),
-        )
-        for key, help_text in gauges:
-            lines += [
-                f"# HELP dlcfn_input_pipeline_{key} {help_text}",
-                f"# TYPE dlcfn_input_pipeline_{key} gauge",
-            ]
+        for key in (
+            "bytes_transferred",
+            "host_input_seconds",
+            "producer_stall_seconds",
+            "consumer_wait_seconds",
+            "overlap_fraction",
+        ):
+            head(f"dlcfn_input_pipeline_{key}")
             for name, agg in pipeline.items():
                 value = agg.get(key)
                 if value is None:
@@ -237,45 +287,23 @@ def render_prometheus(
                     f"{_labels(cluster=cluster, pipeline=name)} {value}"
                 )
     if reshard:
-        counters = (
-            ("dlcfn_reshard_total", "counter", "Live elastic reshards completed.", "total"),
-            (
-                "dlcfn_reshard_seconds",
-                "gauge",
-                "Total seconds spent pausing and resharding (injected clock).",
-                "seconds_total",
-            ),
-            (
-                "dlcfn_reshard_fallback_total",
-                "counter",
-                "Reshards that degraded to the checkpoint/restore path.",
-                "fallback_total",
-            ),
-        )
-        for name, kind, help_text, key in counters:
-            lines += [f"# HELP {name} {help_text}", f"# TYPE {name} {kind}"]
+        for name, key in (
+            ("dlcfn_reshard_total", "total"),
+            ("dlcfn_reshard_seconds", "seconds_total"),
+            ("dlcfn_reshard_fallback_total", "fallback_total"),
+        ):
+            head(name)
             lines.append(f"{name}{_labels(cluster=cluster)} {reshard.get(key, 0)}")
     if mesh:
-        shape = (
-            ("slices", "Slices in the current cluster contract."),
-            ("workers", "Worker hosts in the current cluster contract."),
-            ("chips_total", "Total chips across the current mesh."),
-        )
-        for key, help_text in shape:
+        for key in ("slices", "workers", "chips_total"):
             value = mesh.get(key)
             if value is None:
                 continue
-            lines += [
-                f"# HELP dlcfn_mesh_{key} {help_text}",
-                f"# TYPE dlcfn_mesh_{key} gauge",
-            ]
+            head(f"dlcfn_mesh_{key}")
             lines.append(f"dlcfn_mesh_{key}{_labels(cluster=cluster)} {value}")
     profilers = (profile or {}).get("profilers") or {}
     if profilers:
-        lines += [
-            "# HELP dlcfn_step_phase_ms Step-phase duration quantiles (rolling window).",
-            "# TYPE dlcfn_step_phase_ms summary",
-        ]
+        head("dlcfn_step_phase_ms")
         for prof_name, snap in profilers.items():
             for phase, stats in (snap.get("phases") or {}).items():
                 for quantile, key in (
@@ -301,10 +329,7 @@ def render_prometheus(
                     f"{_labels(cluster=cluster, profiler=prof_name, phase=phase)}"
                     f" {stats.get('count', 0)}"
                 )
-        lines += [
-            "# HELP dlcfn_step_ms Whole-step duration quantiles (rolling window).",
-            "# TYPE dlcfn_step_ms summary",
-        ]
+        head("dlcfn_step_ms")
         for prof_name, snap in profilers.items():
             step_ms = snap.get("step_ms") or {}
             for quantile, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
@@ -322,15 +347,8 @@ def render_prometheus(
                 f" {snap.get('steps', 0)}"
             )
     if serve:
-        for key, help_text in (
-            ("active_slots", "Decode slots currently occupied on the replica."),
-            ("queue_depth", "Requests admitted but not yet slotted."),
-            ("tokens_per_s", "Sampled tokens per second (replica lifetime)."),
-        ):
-            lines += [
-                f"# HELP dlcfn_serve_{key} {help_text}",
-                f"# TYPE dlcfn_serve_{key} gauge",
-            ]
+        for key in ("active_slots", "queue_depth", "tokens_per_s"):
+            head(f"dlcfn_serve_{key}")
             for replica, snap in serve.items():
                 value = snap.get(key)
                 if value is None:
@@ -339,10 +357,7 @@ def render_prometheus(
                     f"dlcfn_serve_{key}"
                     f"{_labels(cluster=cluster, replica=replica)} {value}"
                 )
-        lines += [
-            "# HELP dlcfn_serve_ttft_ms Time-to-first-token quantiles (replica lifetime).",
-            "# TYPE dlcfn_serve_ttft_ms summary",
-        ]
+        head("dlcfn_serve_ttft_ms")
         for replica, snap in serve.items():
             ttft = snap.get("ttft_ms") or {}
             for quantile, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
@@ -360,24 +375,8 @@ def render_prometheus(
                 f" {snap.get('admitted', 0)}"
             )
     if comms:
-        for key, help_text in (
-            (
-                "collective_bytes",
-                "Bytes moved by collectives per execution of the audited program.",
-            ),
-            (
-                "peak_hbm_bytes",
-                "Peak-HBM estimate (args + outputs + temps - aliased) of the audited program.",
-            ),
-            (
-                "collective_count",
-                "Collective ops (all-gather/all-reduce/...) in the audited program's HLO.",
-            ),
-        ):
-            lines += [
-                f"# HELP dlcfn_comms_{key} {help_text}",
-                f"# TYPE dlcfn_comms_{key} gauge",
-            ]
+        for key in ("collective_bytes", "peak_hbm_bytes", "collective_count"):
+            head(f"dlcfn_comms_{key}")
             for program, snap in comms.items():
                 value = snap.get(key)
                 if value is None:
@@ -387,14 +386,8 @@ def render_prometheus(
                     f"{_labels(cluster=cluster, program=program)} {value}"
                 )
     if broker:
-        lines += [
-            "# HELP dlcfn_broker_role Broker role per node (1 = primary, 0 = standby).",
-            "# TYPE dlcfn_broker_role gauge",
-            "# HELP dlcfn_broker_epoch Leadership term the node is fenced to.",
-            "# TYPE dlcfn_broker_epoch gauge",
-            "# HELP dlcfn_broker_up 1 while the node answers on loopback.",
-            "# TYPE dlcfn_broker_up gauge",
-        ]
+        for name in ("dlcfn_broker_role", "dlcfn_broker_epoch", "dlcfn_broker_up"):
+            head(name)
         for node_name in ("primary", "standby"):
             node = broker.get(node_name)
             if not node:
@@ -412,20 +405,72 @@ def render_prometheus(
             lines.append(f"dlcfn_broker_up{labels} {1 if node.get('alive') else 0}")
         lag_s = broker.get("lag_seconds")
         if lag_s is not None:
-            lines += [
-                "# HELP dlcfn_broker_replication_lag_seconds Age of the oldest journal entry the standby has not applied.",
-                "# TYPE dlcfn_broker_replication_lag_seconds gauge",
-            ]
+            head("dlcfn_broker_replication_lag_seconds")
             lines.append(
                 f"dlcfn_broker_replication_lag_seconds{_labels(cluster=cluster)} {lag_s}"
             )
         lag_entries = broker.get("lag_entries")
         if lag_entries is not None:
-            lines += [
-                "# HELP dlcfn_broker_replication_lag_entries Journal entries the standby has not applied.",
-                "# TYPE dlcfn_broker_replication_lag_entries gauge",
-            ]
+            head("dlcfn_broker_replication_lag_entries")
             lines.append(
                 f"dlcfn_broker_replication_lag_entries{_labels(cluster=cluster)} {lag_entries}"
+            )
+    if fleet:
+        head("dlcfn_fleet_workers")
+        lines.append(
+            f"dlcfn_fleet_workers{_labels(cluster=cluster)} {fleet.get('hosts', 0)}"
+        )
+        workers = fleet.get("workers") or {}
+        if workers:
+            head("dlcfn_fleet_telemetry_age_seconds")
+            for worker, row in workers.items():
+                lines.append(
+                    f"dlcfn_fleet_telemetry_age_seconds"
+                    f"{_labels(cluster=cluster, worker=worker)} {row.get('age_s', 0)}"
+                )
+        gauges = fleet.get("gauges") or {}
+        if gauges:
+            head("dlcfn_fleet_gauge")
+            for metric, slot in gauges.items():
+                for agg in ("sum", "max"):
+                    value = slot.get(agg)
+                    if value is None:
+                        continue
+                    lines.append(
+                        f"dlcfn_fleet_gauge"
+                        f"{_labels(cluster=cluster, metric=metric, agg=agg)} {value}"
+                    )
+                for worker, value in (slot.get("last") or {}).items():
+                    lines.append(
+                        f"dlcfn_fleet_gauge"
+                        f"{_labels(cluster=cluster, metric=metric, worker=worker, agg='last')}"
+                        f" {value}"
+                    )
+        summaries = fleet.get("summaries") or {}
+        if summaries:
+            head("dlcfn_fleet_summary")
+            for metric, slot in summaries.items():
+                for quantile, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+                    value = slot.get(key)
+                    if value is None:
+                        continue
+                    lines.append(
+                        f"dlcfn_fleet_summary"
+                        f"{_labels(cluster=cluster, metric=metric, quantile=quantile)}"
+                        f" {value}"
+                    )
+                lines.append(
+                    f"dlcfn_fleet_summary_sum"
+                    f"{_labels(cluster=cluster, metric=metric)} {slot.get('sum', 0.0)}"
+                )
+                lines.append(
+                    f"dlcfn_fleet_summary_count"
+                    f"{_labels(cluster=cluster, metric=metric)} {slot.get('count', 0)}"
+                )
+        dead_fraction = fleet.get("dead_fraction")
+        if dead_fraction is not None:
+            head("dlcfn_worker_dead_fraction")
+            lines.append(
+                f"dlcfn_worker_dead_fraction{_labels(cluster=cluster)} {dead_fraction}"
             )
     return "\n".join(lines) + ("\n" if lines else "")

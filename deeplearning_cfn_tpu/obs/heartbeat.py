@@ -37,7 +37,17 @@ def heartbeat_interval_s() -> float:
 
 
 class Heartbeater(threading.Thread):
-    """Beats ``HEARTBEAT <worker_id>`` at the broker every interval."""
+    """Beats ``HEARTBEAT <worker_id>`` at the broker every interval.
+
+    ``telemetry_source``: optional zero-arg callable returning the
+    agent's current gauge/summary snapshot (the shape
+    ``obs.aggregator.encode_snapshot`` accepts) or ``None`` to skip a
+    cycle.  When set, every successful beat piggybacks one ``TELEM``
+    frame on the SAME connection — fleet telemetry costs zero extra
+    dials and inherits the beat cadence.  A telemetry failure is
+    contained: the beat already landed, so liveness never regresses
+    because a snapshot didn't.
+    """
 
     def __init__(
         self,
@@ -48,6 +58,7 @@ class Heartbeater(threading.Thread):
         interval_s: float | None = None,
         connect_timeout_s: float = 10.0,
         connection_factory=None,
+        telemetry_source=None,
     ):
         # token=None -> BrokerConnection's ambient $DLCFN_BROKER_TOKEN
         # (how agents authenticate); pass "" for an open dev broker.
@@ -65,7 +76,9 @@ class Heartbeater(threading.Thread):
         # (analysis/schedules.py) injects a simulated broker through, so
         # beat_step() can be driven cooperatively without sockets.
         self._connection_factory = connection_factory
+        self._telemetry_source = telemetry_source
         self.beats_sent = 0
+        self.snapshots_sent = 0
         # beats_sent is read by other threads (status displays, tests);
         # the daemon loop increments it only under this lock.
         self._lock = threading.Lock()
@@ -98,6 +111,29 @@ class Heartbeater(threading.Thread):
         # same seq) by obs/trace_export.py to recover cross-host clock
         # offsets for the merged timeline.
         get_recorder().record("heartbeat_sent", worker=self.worker_id, seq=seq)
+        self._ship_telemetry()
+
+    def _ship_telemetry(self) -> None:
+        if self._telemetry_source is None or self._conn is None:
+            return
+        telem = getattr(self._conn, "telem", None)
+        if telem is None:
+            return  # connection seam predates TELEM (old sim); skip quietly
+        try:
+            snapshot = self._telemetry_source()
+            if snapshot is None:
+                return
+            from deeplearning_cfn_tpu.obs.aggregator import encode_snapshot
+
+            telem(self.worker_id, encode_snapshot(snapshot))
+            with self._lock:
+                self.snapshots_sent += 1
+        except Exception as exc:
+            # Contained: the beat landed; a telemetry hiccup must not
+            # tear down the connection liveness depends on.
+            log.warning(
+                "telemetry from %s failed: %s", self.worker_id, exc
+            )
 
     def beat_step(self) -> bool:
         """One protected beat iteration (the body of the daemon loop).

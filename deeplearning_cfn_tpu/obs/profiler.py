@@ -69,6 +69,12 @@ class RollingQuantiles:
     def __len__(self) -> int:
         return len(self._window)
 
+    def samples(self) -> list[float]:
+        """The current window, oldest first — the mergeable raw form the
+        fleet aggregator ships instead of pre-reduced quantiles (per-host
+        p99s cannot be merged; samples can)."""
+        return list(self._window)
+
     def quantiles(self) -> dict[str, float]:
         """``{"p50": ..., "p95": ..., "p99": ...}`` — empty dict if no samples."""
         if not self._window:
@@ -333,6 +339,13 @@ class StepProfiler:
         out["step_ms"] = step_ms
         out["phases"] = dict(sorted(phases.items()))
         return out
+
+    def recent_step_ms(self) -> list[float]:
+        """Raw step-time samples (ms) in the rolling window, oldest
+        first — what ``obs.aggregator.agent_snapshot`` ships as a
+        mergeable sketch."""
+        with self._lock:
+            return self._step_ms.samples()
 
     def journal(self, recorder: FlightRecorder | None = None) -> dict[str, Any]:
         """Record one ``step_profile`` event with the current snapshot."""

@@ -28,6 +28,11 @@ class EventKind(enum.Enum):
     INSTANCE_TERMINATE = "instance-terminate"
     INSTANCE_TERMINATE_ERROR = "instance-terminate-error"
     TEST_NOTIFICATION = "test-notification"  # autoscaling:TEST_NOTIFICATION analog
+    # SLO alert transitions (obs/slo.py): detail carries rule name, state
+    # ("firing"/"resolved"), metric, observed value.  Published on the same
+    # bus as lifecycle so one subscription sees both planes — the CloudWatch
+    # alarm -> SNS topic analog.
+    ALERT = "alert"
 
 
 @dataclass

@@ -33,6 +33,10 @@
 //   HEARTBEAT <worker>\n                  -> OK <count>\n  (record a beat)
 //   HEARTBEAT\n                           -> N <n>\n then n x:
 //                                            HB <worker> <age_ms> <count>\n
+//   TELEM <worker> <len>\n<payload>       -> OK <count>\n  (record a
+//                                            telemetry snapshot, last-write-wins)
+//   TELEM\n                               -> N <n>\n then n x:
+//                                            TM <worker> <age_ms> <count> <len>\n<payload>
 //   SENDID <queue> <rid> <len>\n<payload> -> OK <rid>\n   (idempotent by rid)
 //   ROLE\n                                -> ROLE <role> <epoch> <seq>\n
 //   PROMOTE <epoch>\n                     -> OK <epoch>\n | ERR stale epoch\n
@@ -113,10 +117,21 @@ struct Beat {
   uint64_t count = 0;
 };
 
+// Latest telemetry snapshot per worker (the TELEM verb).  Like a beat
+// with an opaque payload: the broker stores bytes and a steady-clock
+// age; all interpretation (gauge merge, quantile sketches) is
+// Python-side in obs/aggregator.py.
+struct Telem {
+  Clock::time_point last;
+  uint64_t count = 0;
+  std::string payload;
+};
+
 std::mutex g_mu;
 std::map<std::string, Queue> g_queues;
 std::map<std::string, std::string> g_kv;
 std::map<std::string, Beat> g_beats;  // worker -> last heartbeat
+std::map<std::string, Telem> g_telem;  // worker -> latest snapshot
 std::atomic<uint64_t> g_seq{0};
 std::atomic<uint64_t> g_id{0};
 std::string g_token;  // empty = open broker (dev/test direct spawns)
@@ -377,13 +392,42 @@ std::vector<BeatRow> op_heartbeats() {
   return out;
 }
 
+uint64_t op_telem(const std::string& worker, std::string payload) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  Telem& t = g_telem[worker];
+  t.last = Clock::now();
+  t.count++;
+  t.payload = std::move(payload);
+  return t.count;
+}
+
+struct TelemRow {
+  std::string worker;
+  long long age_ms;
+  uint64_t count;
+  std::string payload;
+};
+
+std::vector<TelemRow> op_telems() {
+  std::lock_guard<std::mutex> lock(g_mu);
+  auto now = Clock::now();
+  std::vector<TelemRow> out;
+  out.reserve(g_telem.size());
+  for (const auto& [worker, t] : g_telem) {
+    auto age = std::chrono::duration_cast<std::chrono::milliseconds>(now - t.last);
+    out.push_back({worker, static_cast<long long>(age.count()), t.count, t.payload});
+  }
+  return out;
+}
+
 // --- replication replay --------------------------------------------------
 
 // Replay one replication frame into local state.  Frames are the
-// primary's journaled mutations — SENDID/DELID/PURGE/SET/UNSET/HEARTBEAT
-// — and replay is idempotent: SENDID dedups on rid, DELID on message id,
-// SET/UNSET/PURGE are last-write-wins, and the SYNC handler additionally
-// drops whole duplicate entries by seq.  RECV leases are deliberately
+// primary's journaled mutations —
+// SENDID/DELID/PURGE/SET/UNSET/HEARTBEAT/TELEM — and replay is
+// idempotent: SENDID dedups on rid, DELID on message id,
+// SET/UNSET/PURGE/TELEM are last-write-wins, and the SYNC handler
+// additionally drops whole duplicate entries by seq.  RECV leases are deliberately
 // not replicated: receipts are per-process, so unacked messages simply
 // reappear on the promoted standby (at-least-once, like SQS).
 bool apply_frame(const std::string& frame) {
@@ -434,6 +478,14 @@ bool apply_frame(const std::string& frame) {
     hs >> worker;
     if (worker.empty()) return false;
     op_heartbeat(worker);
+    return true;
+  }
+  if (av == "TELEM") {
+    std::string worker;
+    size_t len = 0;
+    hs >> worker >> len;
+    if (worker.empty()) return false;
+    op_telem(worker, frame.substr(off));
     return true;
   }
   return false;
@@ -569,6 +621,32 @@ void serve(int fd) {
         }
         uint64_t count = op_heartbeat(worker);
         repl_append("HEARTBEAT " + worker + "\n");
+        if (!write_all(fd, "OK " + std::to_string(count) + "\n")) break;
+      }
+    } else if (cmd == "TELEM") {
+      std::string worker;
+      size_t len = 0;
+      ss >> worker >> len;
+      if (worker.empty()) {
+        // Dump mode: the fleet aggregator polls every snapshot in one RPC.
+        auto rows = op_telems();
+        std::string resp = "N " + std::to_string(rows.size()) + "\n";
+        for (auto& t : rows) {
+          resp += "TM " + t.worker + " " + std::to_string(t.age_ms) + " " +
+                  std::to_string(t.count) + " " +
+                  std::to_string(t.payload.size()) + "\n" + t.payload;
+        }
+        if (!write_all(fd, resp)) break;
+      } else {
+        std::string payload;
+        if (len > (64u << 20) || !read_exact(fd, payload, len)) break;
+        if (current_role() != "primary") {
+          if (!write_all(fd, "ERR not primary\n")) break;
+          continue;
+        }
+        uint64_t count = op_telem(worker, payload);
+        repl_append("TELEM " + worker + " " +
+                    std::to_string(payload.size()) + "\n" + payload);
         if (!write_all(fd, "OK " + std::to_string(count) + "\n")) break;
       }
     } else if (cmd == "SENDID") {

@@ -1,0 +1,114 @@
+"""Bench trajectory guard: diff the newest two BENCH_r*.json rounds.
+
+The flat-MFU problem ROADMAP item 1 tracks (0.296 -> 0.301 -> 0.297
+across re-anchors) was only visible at re-anchor time because nothing
+diffed consecutive bench rounds.  This prints a one-line verdict per
+tracked metric — MFU, images/sec/chip, and (when a round records them)
+collective bytes and compile/retrace counts — plus an overall line
+check.sh surfaces on every PR.
+
+Warn-only BY DESIGN: bench rounds run on whatever chip the round
+happened to land on, so a regression here is a prompt to look, not a
+gate.  Exit code is always 0 unless the repo has fewer than two rounds
+to compare (also 0 — nothing to diff is not a failure).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from pathlib import Path
+
+#: parsed-blob keys worth trending, with the direction that counts as
+#: an improvement.  Keys absent from a round (older emitters recorded
+#: fewer fields; collective/compile counts only exist once a round runs
+#: the audit sentinels) are reported as such, never a crash.
+TRACKED: tuple[tuple[str, str, bool], ...] = (
+    ("mfu", "MFU", True),
+    ("value", "images/sec/chip", True),
+    ("vs_baseline", "vs_baseline", True),
+    ("collective_bytes", "collective bytes", False),
+    ("compile_count", "compiles", False),
+    ("retrace_count", "retraces", False),
+)
+
+#: relative change below this is noise, not a verdict
+EPSILON = 0.005
+
+
+def bench_rounds(root: Path) -> list[Path]:
+    """BENCH_r*.json sorted by round number (the filename's integer,
+    not mtime — re-checkouts touch everything)."""
+
+    def round_no(path: Path) -> int:
+        m = re.search(r"BENCH_r(\d+)\.json$", path.name)
+        return int(m.group(1)) if m else -1
+
+    return sorted(root.glob("BENCH_r*.json"), key=round_no)
+
+
+def parsed_metrics(path: Path) -> dict:
+    try:
+        blob = json.loads(path.read_text())
+    except (OSError, ValueError) as e:
+        print(f"bench-compare: unreadable {path.name}: {e}")
+        return {}
+    parsed = blob.get("parsed")
+    return dict(parsed) if isinstance(parsed, dict) else {}
+
+
+def diff_line(key: str, label: str, higher_is_better: bool,
+              old: dict, new: dict) -> tuple[str, str]:
+    """(verdict, line) for one metric; verdict in improved/regressed/
+    flat/missing."""
+    a, b = old.get(key), new.get(key)
+    if not isinstance(a, (int, float)) or not isinstance(b, (int, float)):
+        which = (
+            "either round" if a is None and b is None
+            else ("the old round" if a is None else "the new round")
+        )
+        return "missing", f"  {label}: not recorded in {which}"
+    if a == 0:
+        rel = 0.0 if b == 0 else float("inf")
+    else:
+        rel = (b - a) / abs(a)
+    if abs(rel) < EPSILON:
+        return "flat", f"  {label}: {a} -> {b} (flat)"
+    better = (rel > 0) == higher_is_better
+    verdict = "improved" if better else "regressed"
+    return verdict, f"  {label}: {a} -> {b} ({rel:+.1%}, {verdict})"
+
+
+def main(argv: list[str] | None = None) -> int:
+    root = Path(argv[0]) if argv else Path(__file__).resolve().parent.parent
+    rounds = bench_rounds(root)
+    if len(rounds) < 2:
+        print(f"bench-compare: {len(rounds)} round(s) under {root}, nothing to diff")
+        return 0
+    old_path, new_path = rounds[-2], rounds[-1]
+    old, new = parsed_metrics(old_path), parsed_metrics(new_path)
+    lines, verdicts = [], []
+    for key, label, higher in TRACKED:
+        verdict, line = diff_line(key, label, higher, old, new)
+        lines.append(line)
+        if verdict in ("improved", "regressed", "flat"):
+            verdicts.append((label, verdict))
+    regressed = [label for label, v in verdicts if v == "regressed"]
+    improved = [label for label, v in verdicts if v == "improved"]
+    if regressed:
+        headline = f"REGRESSED ({', '.join(regressed)})"
+    elif improved:
+        headline = f"improved ({', '.join(improved)})"
+    else:
+        headline = "flat"
+    print(
+        f"bench-compare: {old_path.name} -> {new_path.name}: {headline} [warn-only]"
+    )
+    for line in lines:
+        print(line)
+    return 0  # trajectory guard, not a gate — see module docstring
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

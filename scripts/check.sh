@@ -28,15 +28,34 @@ JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   > /tmp/_chaos.json || { cat /tmp/_chaos.json; exit 1; }
 python - <<'EOF' || exit 1
 # The gates this script newly depends on must actually have run: --all is
-# dynamic, so pin the serving SLO scenario and the control-plane failover
-# pair (broker-failover's 1k-agent soak, split-brain's epoch fencing).
+# dynamic, so pin the serving SLO scenario, the control-plane failover
+# pair (broker-failover's 1k-agent soak, split-brain's epoch fencing),
+# and the telemetry/alerting gate (alert-storm: exactly-once alerts
+# through silent deaths, stragglers, and a broker failover).
 import json
 reports = json.load(open("/tmp/_chaos.json"))
 names = {r["scenario"] for r in reports}
-for required in ("serve-replica-loss", "broker-failover", "split-brain"):
+for required in ("serve-replica-loss", "broker-failover", "split-brain",
+                 "alert-storm"):
     assert required in names, f"{required} missing from {sorted(names)}"
 EOF
 echo "chaos: all scenarios held their invariants (report: /tmp/_chaos.json)"
+
+echo "== SLO rule schema (obs/slo.py DEFAULT_RULES vs METRIC_REGISTRY) =="
+# Every shipped alert rule must parse and reference a registered
+# exporter family — a rule over a typo'd metric would silently never
+# fire (docs/OBSERVABILITY.md, "Writing an SLO rule").
+python - <<'EOF' || exit 1
+from deeplearning_cfn_tpu.obs.slo import validate_rules
+errors = validate_rules()
+for e in errors:
+    print(f"slo-schema: {e}")
+assert not errors, f"{len(errors)} invalid SLO rule(s)"
+EOF
+echo "slo-schema: all default rules valid against the metric registry"
+
+echo "== bench trajectory (newest two BENCH rounds, warn-only) =="
+python scripts/bench_compare.py || true
 
 echo "== perf-smoke (compact-dtype input path, structural asserts only) =="
 # 8 virtual devices so the comms_budget stage can rebuild the audited

@@ -12,9 +12,11 @@ scheduler noise; the step-time regression sentinel asserts ordering
 (p99 >= p50) and a deliberately loose absolute ceiling.
 docs/PERFORMANCE.md covers how to read the timing counters it prints.
 A serving-plane scheduler stage, a 1k-agent broker-failover soak (both
-on virtual clocks, structural asserts only), and an exact-match check of
-the audited train step's collective bytes against the committed comms
-budget (8-virtual-device runs only) ride along.
+on virtual clocks, structural asserts only), a fleet-telemetry payload
+cost check (TELEM snapshots stay O(entries) with summaries truncated at
+the wire cap), and an exact-match check of the audited train step's
+collective bytes against the committed comms budget (8-virtual-device
+runs only) ride along.
 
 Exit 0 and one JSON line on success; exit 1 with a message on violation.
 """
@@ -257,6 +259,74 @@ def comms_budget() -> tuple[dict, list[str]]:
     }, failures
 
 
+TELEM_GAUGES = 12
+TELEM_OVERSIZE_SAMPLES = 4096
+
+
+def telemetry_overhead() -> tuple[dict, list[str]]:
+    """Fleet-telemetry stage: structural asserts only, no wall-clock.
+    The TELEM payload rides the heartbeat path, so its cost model must
+    hold by construction: the encoded snapshot carries exactly the
+    gauges handed in (no hidden amplification), summary samples are
+    truncated to MAX_SUMMARY_SAMPLES regardless of how many the caller
+    accumulated, non-finite values serialize as null (never a parse
+    error at the controller), and payload size is O(entries) — bounded
+    by a per-entry budget, not proportional to run length."""
+    from deeplearning_cfn_tpu.obs.aggregator import (
+        MAX_SUMMARY_SAMPLES,
+        FleetAggregator,
+        agent_snapshot,
+        decode_snapshot,
+        encode_snapshot,
+    )
+
+    failures: list[str] = []
+    gauges = {f"dlcfn_fleet_gauge_probe_{i}": float(i) for i in range(TELEM_GAUGES)}
+    gauges["dlcfn_serve_tokens_per_s"] = float("nan")
+    payload = encode_snapshot(
+        agent_snapshot(
+            gauges=gauges,
+            summaries={"dlcfn_step_ms": [float(i) for i in range(TELEM_OVERSIZE_SAMPLES)]},
+        )
+    )
+    body = decode_snapshot(payload)
+    if body is None:
+        failures.append("telemetry snapshot failed to round-trip")
+        return {}, failures
+    if len(body["gauges"]) != len(gauges):
+        failures.append(
+            f"telemetry gauge count amplified: {len(body['gauges'])} != {len(gauges)}"
+        )
+    if body["gauges"]["dlcfn_serve_tokens_per_s"] is not None:
+        failures.append("non-finite gauge escaped json_safe onto the wire")
+    shipped = len(body["summaries"]["dlcfn_step_ms"])
+    if shipped != MAX_SUMMARY_SAMPLES:
+        failures.append(
+            f"summary samples not truncated: shipped {shipped}, "
+            f"cap {MAX_SUMMARY_SAMPLES}"
+        )
+    # O(entries) bound: generous per-entry byte budget (name + float +
+    # JSON punctuation), independent of the 4096 samples accumulated.
+    entries = len(gauges) + MAX_SUMMARY_SAMPLES
+    budget = 64 * entries + 256
+    if len(payload) > budget:
+        failures.append(
+            f"telemetry payload {len(payload)}B over the structural "
+            f"budget {budget}B for {entries} entries"
+        )
+    # The controller-side merge stays a pure fold of its input table.
+    agg = FleetAggregator().merge({"g/0": (1.0, 1, payload), "g/1": (1.0, 1, payload)})
+    if agg["hosts"] != 2 or agg["summaries"]["dlcfn_step_ms"]["count"] != 2 * MAX_SUMMARY_SAMPLES:
+        failures.append("fleet merge dropped or duplicated snapshot samples")
+    return {
+        "gauges": len(gauges),
+        "samples_shipped": shipped,
+        "samples_accumulated": TELEM_OVERSIZE_SAMPLES,
+        "payload_bytes": len(payload),
+        "payload_budget_bytes": budget,
+    }, failures
+
+
 BROKER_SOAK_AGENTS = 1000
 BROKER_SOAK_SENDERS = 100
 
@@ -396,6 +466,9 @@ def main() -> int:
     broker_snap, broker_failures = broker_soak()
     failures.extend(broker_failures)
 
+    telem_snap, telem_failures = telemetry_overhead()
+    failures.extend(telem_failures)
+
     comms_snap, comms_failures = comms_budget()
     failures.extend(comms_failures)
 
@@ -419,6 +492,7 @@ def main() -> int:
                 "step_ms": snap["step_ms"],
                 "serve": serve_snap,
                 "broker_failover": broker_snap,
+                "telemetry": telem_snap,
                 "comms": comms_snap,
             },
             allow_nan=False,

@@ -1,0 +1,613 @@
+"""Fleet telemetry plane: TELEM snapshots, the aggregator, SLO engine,
+crash blackbox, and the merged postmortem timeline.
+
+The chaos suite (alert-storm in test_chaos.py) proves the planes compose
+under faults; these tests pin each component's contract in isolation —
+snapshot encoding is strict JSON, the merge is a pure function of its
+input table, alerts fire/resolve exactly once and HOLD through telemetry
+blackouts, and postmortem ordering is byte-stable under skewed host
+clocks.
+"""
+
+import json
+import shutil
+
+import pytest
+
+from deeplearning_cfn_tpu.obs.aggregator import (
+    MAX_SUMMARY_SAMPLES,
+    FleetAggregator,
+    agent_snapshot,
+    decode_snapshot,
+    encode_snapshot,
+    fleet_metric_values,
+    telemetry_source,
+)
+from deeplearning_cfn_tpu.obs.blackbox import (
+    BlackBox,
+    capture_bundle,
+    merge_bundles,
+    read_bundle,
+    render_timeline,
+    write_bundle,
+)
+from deeplearning_cfn_tpu.obs.exporter import METRIC_REGISTRY, render_prometheus
+from deeplearning_cfn_tpu.obs.recorder import FlightRecorder
+from deeplearning_cfn_tpu.obs.slo import (
+    DEFAULT_RULES,
+    SloEngine,
+    SloRule,
+    validate_rules,
+)
+from deeplearning_cfn_tpu.provision.events import EventBus, EventKind, LifecycleEvent
+
+
+# --- snapshot encoding -------------------------------------------------------
+
+
+def test_snapshot_roundtrip_is_strict_sorted_json():
+    snap = agent_snapshot(
+        gauges={"dlcfn_serve_queue_depth": 3.0},
+        summaries={"dlcfn_step_ms": [12.0, 10.0]},
+    )
+    payload = encode_snapshot(snap)
+    # Deterministic wire bytes: sorted keys, no whitespace.
+    assert payload == encode_snapshot(snap)
+    assert b" " not in payload
+    body = decode_snapshot(payload)
+    assert body["gauges"] == {"dlcfn_serve_queue_depth": 3.0}
+    assert body["summaries"] == {"dlcfn_step_ms": [12.0, 10.0]}
+
+
+def test_non_finite_telemetry_serializes_as_null():
+    """The PR 1 bench-emitter bug class: a NaN p99 from an empty window
+    must become null on the allow_nan=False wire, never a crash or bare
+    ``NaN`` token (invalid JSON)."""
+    payload = encode_snapshot(
+        agent_snapshot(
+            gauges={"dlcfn_serve_tokens_per_s": float("nan")},
+            summaries={"dlcfn_step_ms": [1.0, float("inf"), float("-inf")]},
+        )
+    )
+    assert b"NaN" not in payload and b"Infinity" not in payload
+    body = decode_snapshot(payload)
+    assert body["gauges"]["dlcfn_serve_tokens_per_s"] is None
+    assert body["summaries"]["dlcfn_step_ms"] == [1.0, None, None]
+
+
+def test_summary_samples_are_capped_on_the_wire():
+    snap = agent_snapshot(summaries={"dlcfn_step_ms": list(range(10 * MAX_SUMMARY_SAMPLES))})
+    assert len(snap["summaries"]["dlcfn_step_ms"]) == MAX_SUMMARY_SAMPLES
+    # encode re-caps even if a caller hands an unbounded dict directly.
+    body = decode_snapshot(
+        encode_snapshot({"summaries": {"dlcfn_step_ms": list(range(1000))}})
+    )
+    assert len(body["summaries"]["dlcfn_step_ms"]) == MAX_SUMMARY_SAMPLES
+    # newest samples survive the cap, not oldest
+    assert body["summaries"]["dlcfn_step_ms"][-1] == 999
+
+
+def test_decode_tolerates_torn_and_foreign_bytes():
+    assert decode_snapshot(b"{\"v\":1,\"gauges\"") is None
+    assert decode_snapshot(b"\xff\xfe") is None
+    assert decode_snapshot(b"[1,2,3]") is None
+
+
+def test_telemetry_source_builds_fresh_snapshots():
+    depth = {"n": 1.0}
+    source = telemetry_source("g/0", gauges=lambda: {"dlcfn_serve_queue_depth": depth["n"]})
+    assert source()["gauges"] == {"dlcfn_serve_queue_depth": 1.0}
+    depth["n"] = 7.0
+    assert source()["gauges"] == {"dlcfn_serve_queue_depth": 7.0}
+
+
+# --- fleet merge -------------------------------------------------------------
+
+
+def _payload(gauges=None, summaries=None):
+    return encode_snapshot(agent_snapshot(gauges=gauges, summaries=summaries))
+
+
+def test_merge_folds_gauges_and_summaries_fleet_wide():
+    table = {
+        "g/0": (1.0, 4, _payload({"dlcfn_serve_queue_depth": 2.0}, {"dlcfn_step_ms": [10.0, 30.0]})),
+        "g/1": (2.0, 4, _payload({"dlcfn_serve_queue_depth": 5.0}, {"dlcfn_step_ms": [20.0, 40.0]})),
+    }
+    agg = FleetAggregator().merge(table)
+    assert agg["hosts"] == 2
+    assert agg["gauges"]["dlcfn_serve_queue_depth"] == {
+        "sum": 7.0,
+        "max": 5.0,
+        "last": {"g/0": 2.0, "g/1": 5.0},
+    }
+    summary = agg["summaries"]["dlcfn_step_ms"]
+    assert summary["count"] == 4 and summary["sum"] == 100.0
+    # quantiles reduce once over the concatenated samples, not per host
+    assert summary["p50"] == 30.0 and summary["p99"] == 40.0
+    assert agg["dropped_stale"] == 0 and agg["dropped_corrupt"] == 0
+
+
+def test_merge_is_independent_of_table_insertion_order():
+    a = {"g/1": (1.0, 1, _payload({"dlcfn_mesh_workers": 1.0})),
+         "g/0": (1.0, 1, _payload({"dlcfn_mesh_workers": 1.0}))}
+    b = dict(reversed(list(a.items())))
+    merged_a, merged_b = FleetAggregator().merge(a), FleetAggregator().merge(b)
+    assert merged_a == merged_b
+    assert json.dumps(merged_a, sort_keys=True) == json.dumps(merged_b, sort_keys=True)
+
+
+def test_merge_drops_stale_and_corrupt_without_dropping_the_fleet():
+    table = {
+        "g/0": (1.0, 9, _payload({"dlcfn_mesh_workers": 1.0})),
+        "g/dead": (500.0, 2, _payload({"dlcfn_mesh_workers": 1.0})),
+        "g/torn": (1.0, 3, b"{\"v\":1,"),
+    }
+    agg = FleetAggregator(stale_after_s=120.0).merge(table)
+    assert agg["hosts"] == 1 and list(agg["workers"]) == ["g/0"]
+    assert agg["dropped_stale"] == 1 and agg["dropped_corrupt"] == 1
+    assert agg["gauges"]["dlcfn_mesh_workers"]["sum"] == 1.0
+
+
+def test_merge_surfaces_liveness_dead_fraction():
+    liveness = {
+        "g/0": {"state": "alive"},
+        "g/1": {"state": "dead"},
+        "g/2": {"state": "suspect"},
+        "g/3": {"state": "dead"},
+    }
+    agg = FleetAggregator().merge({}, liveness=liveness)
+    assert agg["dead_fraction"] == 0.5
+    assert "dead_fraction" not in FleetAggregator().merge({})
+
+
+def test_fleet_metric_values_view_for_slo_rules():
+    table = {
+        "g/0": (1.0, 1, _payload({"dlcfn_serve_queue_depth": 2.0}, {"dlcfn_step_ms": [10.0]})),
+    }
+    agg = FleetAggregator().merge(table, liveness={"g/0": {"state": "alive"}})
+    values = fleet_metric_values(agg)
+    assert values["dlcfn_serve_queue_depth"] == {"sum": 2.0, "max": 2.0}
+    assert values["dlcfn_step_ms"]["p99"] == 10.0 and values["dlcfn_step_ms"]["count"] == 1.0
+    assert values["dlcfn_fleet_workers"] == {"value": 1.0}
+    assert values["dlcfn_worker_dead_fraction"] == {"value": 0.0}
+
+
+# --- SLO engine --------------------------------------------------------------
+
+
+RULE = SloRule(
+    name="queue", metric="dlcfn_serve_queue_depth", agg="sum",
+    op=">", threshold=10.0, for_s=30.0, severity="warn",
+)
+
+
+class _Clock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_alert_fires_once_after_for_window_and_resolves_once():
+    clock = _Clock()
+    engine = SloEngine(rules=(RULE,), clock=clock, recorder=FlightRecorder())
+    breach = {"dlcfn_serve_queue_depth": {"sum": 50.0}}
+    heal = {"dlcfn_serve_queue_depth": {"sum": 1.0}}
+    assert engine.evaluate(breach) == []  # pending, not fired
+    clock.now = 29.0
+    assert engine.evaluate(breach) == []  # still inside for_s
+    clock.now = 31.0
+    (fired,) = engine.evaluate(breach)
+    assert fired["state"] == "firing" and fired["rule"] == "queue"
+    assert fired["value"] == 50.0 and fired["at"] == 31.0
+    clock.now = 40.0
+    assert engine.evaluate(breach) == []  # already firing: exactly once
+    clock.now = 50.0
+    (resolved,) = engine.evaluate(heal)
+    assert resolved["state"] == "resolved"
+    assert engine.evaluate(heal) == []  # exactly one resolve
+    snap = engine.snapshot()["queue"]
+    assert snap["fired_count"] == 1 and snap["resolved_count"] == 1
+
+
+def test_blip_shorter_than_for_window_never_fires():
+    clock = _Clock()
+    engine = SloEngine(rules=(RULE,), clock=clock, recorder=FlightRecorder())
+    breach = {"dlcfn_serve_queue_depth": {"sum": 50.0}}
+    heal = {"dlcfn_serve_queue_depth": {"sum": 1.0}}
+    engine.evaluate(breach)
+    clock.now = 20.0
+    engine.evaluate(heal)  # blip healed before for_s
+    clock.now = 45.0
+    # re-breach restarts the pending window from zero
+    assert engine.evaluate(breach) == []
+    clock.now = 60.0
+    assert engine.evaluate(breach) == []
+    clock.now = 76.0
+    assert [t["state"] for t in engine.evaluate(breach)] == ["firing"]
+
+
+def test_firing_alert_holds_through_telemetry_blackout():
+    """A broker failover blanks the fleet table for a round; absence of
+    evidence must neither resolve a firing alert nor fire a pending one."""
+    clock = _Clock()
+    engine = SloEngine(rules=(RULE,), clock=clock, recorder=FlightRecorder())
+    breach = {"dlcfn_serve_queue_depth": {"sum": 50.0}}
+    engine.evaluate(breach)
+    clock.now = 31.0
+    assert len(engine.evaluate(breach)) == 1
+    clock.now = 40.0
+    assert engine.evaluate({}) == []  # blackout: no resolve
+    assert engine.active() == ["queue"]
+    clock.now = 50.0
+    assert engine.evaluate(breach) == []  # still firing, no re-fire
+    # NaN is the same as absent: hold
+    clock.now = 60.0
+    assert engine.evaluate({"dlcfn_serve_queue_depth": {"sum": float("nan")}}) == []
+    assert engine.active() == ["queue"]
+
+
+def test_blackout_clears_a_pending_window():
+    clock = _Clock()
+    engine = SloEngine(rules=(RULE,), clock=clock, recorder=FlightRecorder())
+    engine.evaluate({"dlcfn_serve_queue_depth": {"sum": 50.0}})
+    clock.now = 29.0
+    engine.evaluate({})  # evidence gap resets debounce
+    clock.now = 31.0
+    assert engine.evaluate({"dlcfn_serve_queue_depth": {"sum": 50.0}}) == []
+    clock.now = 60.9
+    assert engine.evaluate({"dlcfn_serve_queue_depth": {"sum": 50.0}}) == []
+    clock.now = 61.0
+    assert len(engine.evaluate({"dlcfn_serve_queue_depth": {"sum": 50.0}})) == 1
+
+
+def test_transitions_are_journaled_and_published():
+    clock = _Clock()
+    recorder = FlightRecorder()
+    bus = EventBus()
+    seen = []
+    bus.subscribe(lambda e: seen.append(e) if e.kind is EventKind.ALERT else None)
+    rule = SloRule(
+        name="instant", metric="dlcfn_serve_queue_depth", agg="sum",
+        op=">", threshold=10.0, for_s=0.0, severity="page",
+    )
+    engine = SloEngine(rules=(rule,), clock=clock, bus=bus, recorder=recorder)
+    engine.evaluate({"dlcfn_serve_queue_depth": {"sum": 50.0}})
+    engine.evaluate({"dlcfn_serve_queue_depth": {"sum": 0.0}})
+    journaled = [e for e in recorder.tail(10) if e["kind"] == "alert"]
+    assert [e["state"] for e in journaled] == ["firing", "resolved"]
+    assert journaled[0]["severity"] == "page"
+    assert [e.detail["state"] for e in seen] == ["firing", "resolved"]
+    assert seen[0].group == "fleet"
+
+
+def test_engine_rejects_bad_rules_and_duplicate_names():
+    bad = SloRule(name="x", metric="not_namespaced", agg="nope", op="~",
+                  threshold=float("nan"), for_s=-1.0, severity="loud")
+    assert len(bad.validate()) >= 5
+    with pytest.raises(ValueError, match="invalid SLO rules"):
+        SloEngine(rules=(bad,))
+    with pytest.raises(ValueError, match="duplicate"):
+        SloEngine(rules=(RULE, RULE))
+
+
+def test_default_rules_validate_against_metric_registry():
+    assert validate_rules() == []
+    assert validate_rules(DEFAULT_RULES) == []
+    rogue = SloRule(name="rogue", metric="dlcfn_not_registered", agg="sum",
+                    op=">", threshold=1.0, for_s=0.0)
+    errors = validate_rules((rogue,))
+    assert errors and "METRIC_REGISTRY" in errors[0]
+
+
+# --- exporter registry hygiene ----------------------------------------------
+
+
+def test_metric_registry_names_types_and_help_are_well_formed():
+    assert len(METRIC_REGISTRY) == len(set(METRIC_REGISTRY))
+    for name, (mtype, help_text) in METRIC_REGISTRY.items():
+        assert name.startswith("dlcfn_"), name
+        assert mtype in ("gauge", "counter", "summary"), (name, mtype)
+        assert help_text.strip(), name
+        assert "\n" not in help_text, name
+
+
+def test_render_never_duplicates_type_headers_across_folds():
+    """Overlapping sections (fleet dead_fraction + liveness families,
+    spans + profiler summaries) must share one header per family."""
+    liveness = {"g/0": {"state": "alive", "age_s": 1.0, "beats": 3}}
+    fleet = FleetAggregator().merge(
+        {"g/0": (1.0, 3, _payload({"dlcfn_serve_queue_depth": 2.0},
+                                  {"dlcfn_step_ms": [10.0, 20.0]}))},
+        liveness={"g/0": {"state": "alive"}},
+    )
+    text = render_prometheus(
+        liveness=liveness,
+        spans={"step": {"count": 2, "total_s": 1.0, "max_s": 0.6,
+                        "p50_s": 0.5, "p95_s": 0.6, "p99_s": 0.6}},
+        cluster="c1",
+        fleet=fleet,
+    )
+    type_lines = [l for l in text.splitlines() if l.startswith("# TYPE ")]
+    families = [l.split()[2] for l in type_lines]
+    assert len(families) == len(set(families)), families
+    # every rendered family must be registered
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        name = line.split("{")[0].split(" ")[0]
+        base = name
+        for suffix in ("_sum", "_count"):
+            if base.endswith(suffix) and base[: -len(suffix)] in METRIC_REGISTRY:
+                base = base[: -len(suffix)]
+        assert base in METRIC_REGISTRY, name
+
+
+def test_render_fleet_section():
+    fleet = FleetAggregator().merge(
+        {
+            "g/0": (1.5, 3, _payload({"dlcfn_serve_queue_depth": 2.0},
+                                     {"dlcfn_step_ms": [10.0]})),
+            "g/1": (2.5, 3, _payload({"dlcfn_serve_queue_depth": 4.0})),
+        },
+        liveness={"g/0": {"state": "alive"}, "g/1": {"state": "dead"}},
+    )
+    text = render_prometheus(fleet=fleet, cluster="c1")
+    assert 'dlcfn_fleet_workers{cluster="c1"} 2' in text
+    assert 'dlcfn_fleet_gauge{cluster="c1",metric="dlcfn_serve_queue_depth",agg="sum"} 6.0' in text
+    assert 'dlcfn_fleet_gauge{cluster="c1",metric="dlcfn_serve_queue_depth",worker="g/1",agg="last"} 4.0' in text
+    assert 'dlcfn_fleet_summary{cluster="c1",metric="dlcfn_step_ms",quantile="0.99"} 10.0' in text
+    assert 'dlcfn_worker_dead_fraction{cluster="c1"} 0.5' in text
+
+
+# --- blackbox bundles --------------------------------------------------------
+
+
+def test_capture_bundle_freezes_journal_tail_and_context(tmp_path):
+    rec = FlightRecorder()
+    for i in range(5):
+        rec.record("span", span="step", i=i)
+    bundle = capture_bundle(
+        reason="test-crash",
+        host="w0",
+        worker="g/0",
+        recorder=rec,
+        last_n=3,
+        config={"cluster": "c1", "loss": float("nan")},
+        budgets={"comms_bytes": 1024},
+        clock=lambda: 123.456,
+    )
+    assert bundle["reason"] == "test-crash" and bundle["captured_ts"] == 123.456
+    assert [e["i"] for e in bundle["events"]] == [2, 3, 4]
+    path = write_bundle(bundle, tmp_path / "bb" / "blackbox-w0.json")
+    raw = path.read_text()
+    assert "NaN" not in raw  # strict JSON survives a crash-time NaN
+    back = read_bundle(path)
+    assert back["config"]["loss"] is None
+    assert back["budgets"] == {"comms_bytes": 1024}
+
+
+def test_blackbox_captures_on_instance_terminate(tmp_path):
+    rec = FlightRecorder()
+    rec.record("bootstrap_complete", cluster="c1")
+    bus = EventBus()
+    box = BlackBox(tmp_path, host="w0", worker="g/0", instance_id="i-0",
+                   recorder=rec, clock=lambda: 1.0)
+    box.attach(bus)
+    box.attach(bus)  # idempotent: one subscription
+    bus.publish(LifecycleEvent(kind=EventKind.INSTANCE_TERMINATE, group="g",
+                               instance_id="i-other"))
+    assert box.captures == 0  # filtered: someone else's reap notice
+    bus.publish(LifecycleEvent(kind=EventKind.INSTANCE_TERMINATE, group="g",
+                               instance_id="i-0"))
+    assert box.captures == 1
+    bundle = read_bundle(box.path)
+    assert bundle["reason"] == "instance-terminate:i-0"
+    assert bundle["events"][-1]["kind"] == "bootstrap_complete"
+    box.detach(bus)
+    bus.publish(LifecycleEvent(kind=EventKind.INSTANCE_TERMINATE, group="g",
+                               instance_id="i-0"))
+    assert box.captures == 1  # detached means detached
+
+
+# --- postmortem merge: skewed clocks, deterministic ordering -----------------
+
+
+def _skewed_bundles():
+    """Controller at true time; worker clock skewed +500s.  The worker's
+    beats (seq-matched heartbeat_sent/heartbeat_observed pairs, the PR 8
+    alignment fixtures) recover the offset; events constructed to collide
+    at the same aligned instant must tie-break by (host, seq)."""
+    ctl_events = [
+        {"ts": 1000.0, "kind": "heartbeat_observed", "worker": "g/0", "seq": 1, "age_s": 0.5},
+        {"ts": 1002.0, "kind": "alert", "rule": "queue", "state": "firing",
+         "metric": "dlcfn_serve_queue_depth", "agg": "sum", "value": 50.0},
+        {"ts": 1005.0, "kind": "heartbeat_observed", "worker": "g/0", "seq": 2, "age_s": 0.5},
+        {"ts": 1006.0, "kind": "tie", "who": "ctl-first"},
+        {"ts": 1006.0, "kind": "tie", "who": "ctl-second"},
+        {"ts": 1010.0, "kind": "heartbeat_observed", "worker": "g/0", "seq": 3, "age_s": 0.5},
+    ]
+    w0_events = [
+        {"ts": 1499.5, "kind": "heartbeat_sent", "worker": "g/0", "seq": 1},
+        {"ts": 1503.0, "kind": "span", "span": "step"},
+        {"ts": 1504.5, "kind": "heartbeat_sent", "worker": "g/0", "seq": 2},
+        {"ts": 1506.0, "kind": "tie", "who": "w0"},  # aligns to 1006.0 exactly
+        {"ts": 1509.5, "kind": "heartbeat_sent", "worker": "g/0", "seq": 3},
+    ]
+    ctl = {"v": 1, "host": "ctl", "worker": None, "reason": "operator-requested",
+           "captured_ts": 1011.0, "events": ctl_events, "profiler": None,
+           "config": None, "budgets": None}
+    w0 = {"v": 1, "host": "w0", "worker": "g/0", "reason": "bootstrap-failed: x",
+          "captured_ts": 1511.0, "events": w0_events, "profiler": None,
+          "config": None, "budgets": None}
+    return ctl, w0
+
+
+def test_postmortem_aligns_skewed_clocks_and_orders_deterministically():
+    ctl, w0 = _skewed_bundles()
+    merged = merge_bundles([ctl, w0])
+    assert merged["aligned"] and merged["reference"] == "ctl"
+    assert merged["hosts"]["w0"]["offset_s"] == -500.0
+    assert merged["hosts"]["ctl"]["offset_s"] == 0.0
+    # worker events landed on the controller clock
+    spans = [e for e in merged["events"] if e["kind"] == "span"]
+    assert spans[0]["ts"] == 1003.0
+    # three events collide at aligned ts 1006.0: (host, seq) breaks ties —
+    # ctl (host "ctl" < "w0") in journal order, then the worker's
+    ties = [e for e in merged["events"] if e["kind"] == "tie"]
+    assert [(e["bb_host"], e.get("who")) for e in ties] == [
+        ("ctl", "ctl-first"), ("ctl", "ctl-second"), ("w0", "w0"),
+    ]
+    # alerts surface as the overlay
+    assert [a["rule"] for a in merged["alerts"]] == ["queue"]
+    # bundle input order must not change the timeline
+    again = merge_bundles([w0, ctl])
+    assert json.dumps(merged["events"], sort_keys=True) == json.dumps(
+        again["events"], sort_keys=True
+    )
+
+
+def test_postmortem_golden_timeline(tmp_path):
+    """Golden pin: the merged ordering under skew is part of the
+    postmortem contract — regenerate with
+    `python -m tests.test_fleet_telemetry` only on an intentional change."""
+    from pathlib import Path
+
+    ctl, w0 = _skewed_bundles()
+    merged = merge_bundles([ctl, w0])
+    got = [
+        [e["ts"], e["bb_host"], e["bb_seq"], e["kind"]] for e in merged["events"]
+    ]
+    golden = Path(__file__).parent / "goldens" / "postmortem_timeline.json"
+    want = json.loads(golden.read_text())
+    assert got == want, (
+        "postmortem ordering changed; if intentional regenerate "
+        "tests/goldens/postmortem_timeline.json (see this test's docstring)"
+    )
+
+
+def test_postmortem_without_beats_degrades_to_raw_timestamps():
+    merged = merge_bundles([
+        {"host": "a", "events": [{"ts": 5.0, "kind": "span"}], "reason": "x"},
+        {"host": "b", "events": [{"ts": 1.0, "kind": "span"}], "reason": "y"},
+    ])
+    assert not merged["aligned"] and merged["reference"] is None
+    assert [e["bb_host"] for e in merged["events"]] == ["b", "a"]
+
+
+def test_render_timeline_is_readable(tmp_path):
+    ctl, w0 = _skewed_bundles()
+    text = render_timeline(merge_bundles([ctl, w0]))
+    assert "postmortem: 2 host(s)" in text
+    assert "heartbeat-paired" in text
+    assert "queue -> firing" in text
+    assert "bootstrap-failed: x" in text
+
+
+def test_cli_postmortem_merges_a_bundle_dir(tmp_path, capsys):
+    from deeplearning_cfn_tpu.cli import main
+
+    ctl, w0 = _skewed_bundles()
+    write_bundle(ctl, tmp_path / "blackbox-ctl.json")
+    write_bundle(w0, tmp_path / "blackbox-w0.json")
+    assert main(["postmortem", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "postmortem: 2 host(s)" in out and "queue -> firing" in out
+    assert main(["postmortem", str(tmp_path), "--format", "json"]) == 0
+    merged = json.loads(capsys.readouterr().out)
+    assert merged["aligned"] and len(merged["hosts"]) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(SystemExit, match="needs bundle"):
+        main(["postmortem", str(empty)])
+
+
+# --- TELEM against the native broker (acceptance) ----------------------------
+
+native = pytest.mark.skipif(
+    shutil.which("g++") is None or shutil.which("make") is None,
+    reason="native toolchain unavailable",
+)
+
+
+@native
+def test_telem_roundtrip_and_fleet_merge_against_real_broker():
+    from deeplearning_cfn_tpu.cluster.broker_client import (
+        BrokerConnection,
+        BrokerProcess,
+    )
+
+    with BrokerProcess() as broker:
+        conn = BrokerConnection("127.0.0.1", broker.port, token="")
+        try:
+            p0 = _payload({"dlcfn_serve_queue_depth": 2.0}, {"dlcfn_step_ms": [10.0]})
+            p1 = _payload({"dlcfn_serve_queue_depth": 3.0}, {"dlcfn_step_ms": [20.0]})
+            assert conn.telem("g/0", b"stale-overwritten") == 1
+            assert conn.telem("g/0", p0) == 2  # last-write-wins, count rises
+            assert conn.telem("g/1", p1) == 1
+            table = conn.telemetry()
+        finally:
+            conn.close()
+    assert set(table) == {"g/0", "g/1"}
+    age_s, count, payload = table["g/0"]
+    assert count == 2 and 0 <= age_s < 5.0 and payload == p0
+    agg = FleetAggregator().merge(table)
+    assert agg["hosts"] == 2
+    assert agg["gauges"]["dlcfn_serve_queue_depth"]["sum"] == 5.0
+    assert agg["summaries"]["dlcfn_step_ms"]["count"] == 2
+
+
+@native
+def test_cli_status_fleet_serves_merged_gauges(capsys, monkeypatch):
+    """Acceptance: `dlcfn status --fleet` renders gauges merged across
+    two workers' snapshots from a live broker, json and prom."""
+    from deeplearning_cfn_tpu.cli import main
+    from deeplearning_cfn_tpu.cluster.broker_client import (
+        BrokerConnection,
+        BrokerProcess,
+    )
+
+    monkeypatch.delenv("DLCFN_BROKER_TOKEN", raising=False)
+    with BrokerProcess() as broker:
+        conn = BrokerConnection("127.0.0.1", broker.port, token="")
+        try:
+            conn.heartbeat("g/0")
+            conn.heartbeat("g/1")
+            conn.telem("g/0", _payload({"dlcfn_serve_queue_depth": 2.0}))
+            conn.telem("g/1", _payload({"dlcfn_serve_queue_depth": 4.0}))
+        finally:
+            conn.close()
+        target = f"127.0.0.1:{broker.port}"
+        assert main(["status", "--broker", target, "--fleet"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        fleet = out["fleet"]
+        assert fleet["hosts"] == 2
+        assert fleet["gauges"]["dlcfn_serve_queue_depth"]["sum"] == 6.0
+        assert fleet["gauges"]["dlcfn_serve_queue_depth"]["last"] == {
+            "g/0": 2.0, "g/1": 4.0,
+        }
+        assert main(
+            ["status", "--broker", target, "--fleet", "--format", "prom"]
+        ) == 0
+        text = capsys.readouterr().out
+        assert 'dlcfn_fleet_workers ' in text.replace("{}", " ") or "dlcfn_fleet_workers" in text
+        assert 'metric="dlcfn_serve_queue_depth",agg="sum"} 6.0' in text
+
+
+def test_cli_status_fleet_requires_a_broker_source():
+    from deeplearning_cfn_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="--fleet"):
+        main(["status", "--fleet", "--journal", "/nonexistent"])
+
+
+if __name__ == "__main__":  # golden regeneration (see the golden test)
+    from pathlib import Path
+
+    ctl, w0 = _skewed_bundles()
+    merged = merge_bundles([ctl, w0])
+    rows = [[e["ts"], e["bb_host"], e["bb_seq"], e["kind"]] for e in merged["events"]]
+    out = Path(__file__).parent / "goldens" / "postmortem_timeline.json"
+    out.write_text(json.dumps(rows, indent=1) + "\n")
+    print(f"wrote {out} ({len(rows)} rows)")

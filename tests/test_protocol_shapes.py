@@ -34,6 +34,8 @@ def test_shape_extraction_is_not_vacuous():
     assert canon["RECV"] == {(3, False)}
     # HEARTBEAT's two spec lines: record (1 arg) and table dump (0 args).
     assert canon["HEARTBEAT"] == {(0, False), (1, False)}
+    # TELEM's two spec lines: record (2 args + payload) and dump (0 args).
+    assert canon["TELEM"] == {(2, True), (0, False)}
     # The replication verbs (warm-standby control plane, PR 10).
     assert canon["SENDID"] == {(3, True)}  # SENDID <queue> <rid> <nbytes>
     assert canon["ROLE"] == {(0, False)}
@@ -50,6 +52,8 @@ def test_shape_extraction_is_not_vacuous():
     assert "PONG" in client_tokens["PING"]
     assert client_frames["RECV"]["MSG"] == {5}
     assert client_frames["HEARTBEAT"]["HB"] == {4}
+    # TM frames carry a trailing <len> for the payload that follows.
+    assert client_frames["TELEM"]["TM"] == {5}
     # ROLE replies with a 4-token frame: ROLE <role> <epoch> <seq>.
     assert client_frames["ROLE"]["ROLE"] == {4}
 
@@ -58,6 +62,7 @@ def test_shape_extraction_is_not_vacuous():
     assert cpp_frames["RECV"]["MSG"] == 5
     assert cpp_frames["HEARTBEAT"]["HB"] == 4
     assert cpp_frames["ROLE"]["ROLE"] == 4
+    assert cpp_frames["TELEM"]["TM"] == 5
 
 
 def _mutated(tmp_path: Path, src: Path, old: str, new: str) -> Path:
