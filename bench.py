@@ -8,6 +8,15 @@ publicly documented tensorpack+Horovod ResNet-50 throughput on the
 reference's own hardware class (~350 images/sec per V100 on p3.16xlarge,
 fp16, batch 64/GPU) — the workload the reference stack existed to run.
 
+Input regime (the PR 13 overlap architecture, docs/PERFORMANCE.md): batches
+cross the host->device link as uint8 (4x fewer bytes than f32) and
+dequantize+normalize INSIDE the compiled step (TrainerConfig.input_stats) —
+the scanned multi-step program therefore carries its own input stage, and
+the multi-step phase consumes DISTINCT pre-staged [k, B, ...] stacks kept
+double-buffered on device by DevicePrefetcher, each freed (donated) right
+after its dispatch.  An int8-WEIGHTS forward variant is reported alongside
+(ops/quant.py), riding the same compact-transfer idea one level up.
+
 Runs on whatever accelerator JAX exposes (the driver provides one real TPU
 chip).  Prints exactly one JSON line.
 """
@@ -20,6 +29,7 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deeplearning_cfn_tpu.utils.compat import set_mesh
 
@@ -39,9 +49,17 @@ MEASURE_STEPS = 20
 # and the per-dispatch overhead amortizes.  k=4 is the measured knee.
 STEPS_PER_CALL = 4
 
+# Forward-only window for the int8-weights variant: cheaper per step than
+# training, so fewer steps still average out dispatch jitter.
+QUANT_WARMUP_STEPS = 2
+QUANT_MEASURE_STEPS = 10
 
 PIPELINE_WORKERS = 2
 PIPELINE_POOL_BATCHES = 4
+
+# Device-resident stacks the multi-step phase keeps ahead of compute: 2 =
+# double buffering (one consumed by the in-flight program, one staged).
+STACK_BUFFER = 2
 
 
 def measure_input_pipeline(
@@ -49,19 +67,16 @@ def measure_input_pipeline(
 ) -> tuple[dict, dict]:
     """End-to-end device-resident input pipeline measurement: pooled
     uint8 synthetic batches (4x smaller PCIe payload than float32)
-    through ``DevicePrefetcher(workers=2)`` into the ALREADY-compiled
-    bf16 train step, with dequantize+normalize as a small jitted stage in
-    front (recompiling the full step for uint8 inputs would double the
-    bench's compile bill for no measurement value).  Returns the
+    through ``DevicePrefetcher(workers=2)`` straight into the ALREADY-
+    compiled train step — with ``TrainerConfig.input_stats`` set the
+    step program itself dequantizes, so the uint8 batch IS the step's
+    input signature and this phase adds zero compiles.  Returns the
     per-chip throughput plus the PipelineStats counters, and the
     StepProfiler snapshot (data_wait here includes consumer waits on
     the prefetch buffer; h2d is producer-side and overlapped)."""
     from deeplearning_cfn_tpu.obs.profiler import StepProfiler
     from deeplearning_cfn_tpu.train.data import DevicePrefetcher, SyntheticDataset
-    from deeplearning_cfn_tpu.train.pipeline import (
-        PipelineStats,
-        dequantize_normalize,
-    )
+    from deeplearning_cfn_tpu.train.pipeline import PipelineStats
 
     ds = SyntheticDataset.imagenet_like(
         batch_size=batch,
@@ -69,11 +84,6 @@ def measure_input_pipeline(
         dtype="uint8",
         pool_batches=PIPELINE_POOL_BATCHES,
     )
-    mean, std = ds.input_stats
-
-    @jax.jit
-    def dequant(x):
-        return dequantize_normalize(x, mean, std, compute_dtype=jnp.bfloat16)
 
     steps = WARMUP_STEPS + MEASURE_STEPS
     stats = PipelineStats(name="bench")
@@ -94,7 +104,7 @@ def measure_input_pipeline(
             profiler.start()
             for i, b in enumerate(profiler.wrap_source(prefetcher)):
                 with profiler.phase("dispatch"):
-                    state, metrics = step(state, dequant(b.x), b.y)
+                    state, metrics = step(state, b.x, b.y)
                 if i == WARMUP_STEPS - 1:
                     # Sync before opening the timed window.
                     with profiler.sync_boundary(WARMUP_STEPS):
@@ -122,6 +132,69 @@ def measure_input_pipeline(
     }, profiler.journal()
 
 
+def measure_quantized(trainer, model, state, x, batch: int, n_chips: int) -> dict:
+    """int8-WEIGHTS forward variant (ops/quant.py): conv/dense kernels
+    cross HBM as int8 + per-channel scales and upcast inside the jitted
+    apply, next to their consumers.  Measured as eval-mode forward
+    throughput against the same program with float weights, plus the
+    worst-case logit deviation on one batch — the compact-weights
+    counterpart of the uint8 input plumbing, reported alongside the bf16
+    training numbers rather than replacing them."""
+    from deeplearning_cfn_tpu.ops.quant import (
+        dequantize_tree,
+        quantize_tree,
+        quantized_nbytes,
+        tree_nbytes,
+    )
+
+    params, model_state = state.params, state.model_state
+    # One jitted program for the whole-tree quantization: eager per-kernel
+    # jnp ops would compile a tiny program per layer shape and read as
+    # dozens of retraces in the compile watcher.
+    qparams, passthrough = jax.jit(quantize_tree)(params)
+
+    @jax.jit
+    def fwd_float(p, ms, xb):
+        return model.apply({"params": p, **ms}, trainer._normalize_input(xb), train=False)
+
+    @jax.jit
+    def fwd_int8(q, pth, ms, xb):
+        p = dequantize_tree(q, pth)
+        return model.apply({"params": p, **ms}, trainer._normalize_input(xb), train=False)
+
+    def timed(fn, *args) -> tuple[float, jax.Array]:
+        out = None
+        for _ in range(QUANT_WARMUP_STEPS):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(QUANT_MEASURE_STEPS):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0, out
+
+    with set_mesh(trainer.mesh):
+        dt_float, logits_float = timed(fwd_float, params, model_state, x)
+        dt_int8, logits_int8 = timed(fwd_int8, qparams, passthrough, model_state, x)
+    # Host-side diff (numpy after device_get): eager jnp here would add
+    # spurious tiny-program compiles to the watcher's tally.
+    lf = np.asarray(jax.device_get(logits_float), np.float32)
+    li = np.asarray(jax.device_get(logits_int8), np.float32)
+    diff = float(np.max(np.abs(lf - li)))
+    per_chip = lambda dt: round(batch * QUANT_MEASURE_STEPS / dt / n_chips, 2)
+    float_bytes = tree_nbytes(params)
+    int8_bytes = quantized_nbytes(qparams) + tree_nbytes(passthrough)
+    return {
+        "weights_dtype": "int8",
+        "param_bytes_float": float_bytes,
+        "param_bytes_int8": int8_bytes,
+        "param_bytes_ratio": round(int8_bytes / float_bytes, 3) if float_bytes else None,
+        "forward_images_per_sec_per_chip_float": per_chip(dt_float),
+        "forward_images_per_sec_per_chip_int8": per_chip(dt_int8),
+        "max_abs_logit_diff": round(diff, 4),
+    }
+
+
 def main() -> None:
     from deeplearning_cfn_tpu.analysis.compile_audit import (
         CompileWatcher,
@@ -135,6 +208,14 @@ def main() -> None:
     from deeplearning_cfn_tpu.examples.common import enable_compile_cache
     from deeplearning_cfn_tpu.models.resnet import ResNet50
     from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
+    from deeplearning_cfn_tpu.train.data import (
+        DevicePrefetcher,
+        SyntheticDataset,
+        device_put_tree,
+        donate_buffers,
+        stack_batches,
+    )
+    from deeplearning_cfn_tpu.train.pipeline import PipelineStats
     from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
 
     enable_compile_cache()
@@ -145,6 +226,12 @@ def main() -> None:
 
     mesh = build_mesh(MeshSpec.data_parallel(n_chips), devices)
     model = ResNet50(dtype=jnp.bfloat16)
+    ds = SyntheticDataset.imagenet_like(
+        batch_size=batch,
+        image_size=IMAGE_SIZE,
+        dtype="uint8",
+        pool_batches=PIPELINE_POOL_BATCHES,
+    )
     trainer = Trainer(
         model,
         mesh,
@@ -153,15 +240,18 @@ def main() -> None:
             learning_rate=0.1,
             has_train_arg=True,
             label_smoothing=0.1,
+            # uint8 inputs dequantize+normalize INSIDE the compiled step
+            # (and inside the multi-step scan body) — the host never
+            # touches a float image and every program owns its input stage.
+            input_stats=ds.input_stats,
         ),
     )
 
-    rng = np.random.default_rng(0)
-    x = rng.standard_normal((batch, IMAGE_SIZE, IMAGE_SIZE, 3))
-    y = rng.integers(0, 1000, size=batch).astype(np.int32)
-    # bf16 inputs: halves the host->device bytes and matches compute dtype.
-    x = jax.device_put(jnp.asarray(x, jnp.bfloat16), trainer.batch_sharding)
-    y = jax.device_put(jnp.asarray(y), trainer.batch_sharding)
+    # One resident uint8 batch for the dispatch-bound phases (single-step
+    # loop, donation probe, quantized forward): placed once, reused.
+    b0 = next(iter(ds.batches(1)))
+    x = jax.device_put(b0.x, trainer.batch_sharding)
+    y = jax.device_put(b0.y, trainer.batch_sharding)
 
     # The watcher turns the whole bench into its own compile audit:
     # per-function compile counts from the jax_log_compiles stream, so a
@@ -212,48 +302,77 @@ def main() -> None:
         assert np.isfinite(final_loss)
         single_step_per_chip = batch * MEASURE_STEPS / dt / n_chips
 
-        # Headline mode: k iterations per compiled program (STEPS_PER_CALL).
+        # Headline mode: k iterations per compiled program (STEPS_PER_CALL)
+        # fed DISTINCT pre-staged batch stacks.  The prefetcher keeps
+        # STACK_BUFFER [k, B, ...] uint8 stacks device-resident (producer
+        # H2D overlaps the in-flight program's compute) and each consumed
+        # stack is freed right after its dispatch — deletion is safe
+        # in-flight, and it caps input HBM at ~STACK_BUFFER+1 stacks
+        # (docs/PERFORMANCE.md, "the overlap architecture").
         k = STEPS_PER_CALL
-        with set_mesh(trainer.mesh):
-            kfn = trainer.multi_step_fn(k)
-
-            # One named jit for both broadcasts: done bare, each
-            # jnp.broadcast_to dispatches its own anonymous
-            # "broadcast_in_dim" program and the pair reads as a retrace
-            # in the compile audit (same op name, two avals).
-            @jax.jit
-            def stack_k(a, b):
-                return (
-                    jnp.broadcast_to(a, (k, *a.shape)),
-                    jnp.broadcast_to(b, (k, *b.shape)),
-                )
-
-            xs, ys = stack_k(x, y)
-            # AOT compile BEFORE the first dispatch: the per-program
-            # cost model for the k-step program (its flops cover all k
-            # iterations), and — like compile_stats for the single step
-            # — it populates the jit dispatch cache under this mesh, so
-            # the warmup dispatch below hits the cache instead of
-            # compiling a second time (compile_count unchanged).
-            kexe = kfn.lower(state, xs, ys).compile()
-            kcost = program_cost(kexe)
-            for _ in range(max(1, WARMUP_STEPS // k)):
-                state, losses = kfn(state, xs, ys)
-            float(np.asarray(jax.device_get(losses))[-1])
-            outer = max(1, MEASURE_STEPS // k)
-            prof_multi = StepProfiler(name=f"multi_step_k{k}")
-            t0 = time.perf_counter()
-            prof_multi.start()
-            for _ in range(outer):
-                with prof_multi.phase("dispatch"):
-                    state, losses = kfn(state, xs, ys)
-                prof_multi.step_done(steps=k)
-            with prof_multi.sync_boundary(outer * k):
-                final_loss = float(np.asarray(jax.device_get(losses))[-1])
+        warmup_calls = max(1, WARMUP_STEPS // k)
+        outer = max(1, MEASURE_STEPS // k)
+        stacked_sharding = NamedSharding(mesh, P(None, *trainer.batch_sharding.spec))
+        prof_multi = StepProfiler(name=f"multi_step_k{k}")
+        stack_stats = PipelineStats(name="bench_stacks")
+        stacked = stack_batches(ds.batches((warmup_calls + outer) * k), k)
+        prefetcher = DevicePrefetcher(
+            stacked,
+            stacked_sharding,
+            size=STACK_BUFFER,
+            workers=PIPELINE_WORKERS,
+            stats=stack_stats,
+            profiler=prof_multi,
+        )
+        kfn = trainer.multi_step_fn(k)
+        kexe = kcost = None
+        stack_donated = 0
+        resident_stacks_peak = 0
+        t0 = None
+        try:
+            with set_mesh(trainer.mesh):
+                prof_multi.start()
+                for i, stack in enumerate(prof_multi.wrap_source(prefetcher)):
+                    with prof_multi.phase("h2d"):
+                        # Prefetched stacks are already resident with the
+                        # stacked sharding — an identity check per leaf.
+                        xs = device_put_tree(stack.x, stacked_sharding)
+                        ys = device_put_tree(stack.y, stacked_sharding)
+                    if kexe is None:
+                        # AOT compile BEFORE the first dispatch: the
+                        # per-program cost model for the k-step program
+                        # (its flops cover all k iterations), and — like
+                        # compile_stats for the single step — it populates
+                        # the jit dispatch cache under this mesh, so the
+                        # dispatch below hits the cache instead of
+                        # compiling a second time (compile_count unchanged).
+                        kexe = kfn.lower(state, xs, ys).compile()
+                        kcost = program_cost(kexe)
+                    resident_stacks_peak = max(
+                        resident_stacks_peak, len(prefetcher.buffered())
+                    )
+                    with prof_multi.phase("dispatch"):
+                        state, losses = kfn(state, xs, ys)
+                    # The stack is this loop's own placement; XLA cannot
+                    # donate it (no same-shaped output to alias into), so
+                    # free it explicitly (train/data.donate_buffers).
+                    stack_donated += donate_buffers((xs, ys))
+                    if i == warmup_calls - 1:
+                        with prof_multi.sync_boundary(warmup_calls * k):
+                            float(np.asarray(jax.device_get(losses))[-1])
+                        t0 = time.perf_counter()
+                    prof_multi.step_done(steps=k)
+                with prof_multi.sync_boundary(outer * k):
+                    final_loss = float(np.asarray(jax.device_get(losses))[-1])
             dt_multi = dt = time.perf_counter() - t0
+        finally:
+            prefetcher.close()
         assert np.isfinite(final_loss)
         multi_step_per_chip = batch * outer * k / dt / n_chips
 
+        # Quantized-forward first: the pipeline phase dispatches the
+        # DONATING step, after which this scope's `state` buffers are gone.
+        quantized = measure_quantized(trainer, model, state, x, batch, n_chips)
         pipeline, pipeline_profile = measure_input_pipeline(
             trainer, state, batch, n_chips
         )
@@ -272,6 +391,12 @@ def main() -> None:
             f"single_step ({single_step_per_chip:.0f}) beat "
             f"multi_step_k{k} ({multi_step_per_chip:.0f}) on this draw"
         )
+    # Tag each phase profiler with ITS OWN dispatch mode (not the
+    # winner — that's parsed.mode) so journaled step_profile events and
+    # the step_time block attribute timings to the loop that produced
+    # them.
+    prof_single.set_label("mode", "single_step")
+    prof_multi.set_label("mode", f"multi_step_k{k}")
 
     from deeplearning_cfn_tpu.train.metrics import peak_flops_per_chip
 
@@ -310,6 +435,22 @@ def main() -> None:
             else None,
         },
     }
+    # The overlap block is the acceptance surface for the double-buffered
+    # input path: >= 2 stacks were device-resident during the timed
+    # window, consumed stacks were actually freed, and the consumer's
+    # data_wait stayed ~0 (the prefetcher ran ahead of compute).
+    stack_snap = stack_stats.snapshot()
+    overlap = {
+        "steps_per_call": k,
+        "stack_buffer": STACK_BUFFER,
+        "device_resident_stacks_peak": resident_stacks_peak,
+        "input_stack_donated_bytes": stack_donated,
+        "stack_bytes_transferred": stack_snap["bytes_transferred"],
+        "stack_overlap_fraction": stack_snap["overlap_fraction"],
+        "data_wait_p50_ms": snap_multi.get("phases", {})
+        .get("data_wait", {})
+        .get("p50_ms", 0.0),
+    }
     # Communication + HBM pressure per compiled program, read straight
     # off the executables' HLO/memory analysis (the other two MFU
     # killers the step-time blocks can't see — docs/STATIC_ANALYSIS.md
@@ -331,6 +472,7 @@ def main() -> None:
     }
     # Per-compiled-program MFU/MBU from each program's own cost model
     # and measured call time — attribution finer than whole-bench MFU.
+    # "headline" marks the program the top-level value came from.
     programs = {
         "train_step": program_attribution(
             flops=stats.get("cost_flops_per_step"),
@@ -347,6 +489,8 @@ def main() -> None:
             peak_flops=peak,
         ),
     }
+    programs["train_step"]["headline"] = mode == "single_step"
+    programs[f"multi_step_k{k}"]["headline"] = mode == f"multi_step_k{k}"
     print(
         json.dumps(
             {
@@ -357,6 +501,7 @@ def main() -> None:
                 "mfu": round(mfu, 4) if mfu is not None else None,
                 "mode": mode,
                 "mode_reason": mode_reason,
+                "transfer_dtype": "uint8",
                 "single_step_images_per_sec_per_chip": round(
                     single_step_per_chip, 2
                 ),
@@ -364,6 +509,8 @@ def main() -> None:
                     multi_step_per_chip, 2
                 ),
                 "input_pipeline": pipeline,
+                "overlap": overlap,
+                "quantized": quantized,
                 "step_time": step_time,
                 "programs": programs,
                 # Compile-behavior correlates for the MFU trajectory

@@ -206,6 +206,7 @@ class StepProfiler:
         self._step_start: float | None = None
         self._critical_s = 0.0
         self._interval: dict[str, float] = {}
+        self._labels: dict[str, Any] = {}
 
     # -- marking ---------------------------------------------------------
 
@@ -316,6 +317,16 @@ class StepProfiler:
             # Journal outside the lock (DLC203: no I/O under a lock).
             (self._recorder or get_recorder()).record("step_time", **event)
 
+    def set_label(self, key: str, value: Any) -> None:
+        """Attach an annotation carried by every later ``snapshot()``/
+        ``journal()`` under ``labels`` — e.g. the bench tags each phase
+        profiler with its dispatch ``mode``, so the journaled
+        ``step_profile`` events say which loop produced the timings."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._labels[str(key)] = value
+
     # -- reporting -------------------------------------------------------
 
     def snapshot(self) -> dict[str, Any]:
@@ -338,6 +349,9 @@ class StepProfiler:
             out[f"{phase}_ms"] = round(total_ms / steps, 3) if steps else 0.0
         out["step_ms"] = step_ms
         out["phases"] = dict(sorted(phases.items()))
+        with self._lock:
+            if self._labels:
+                out["labels"] = dict(self._labels)
         return out
 
     def recent_step_ms(self) -> list[float]:

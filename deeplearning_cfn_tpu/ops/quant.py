@@ -1,0 +1,104 @@
+"""Symmetric int8 weight quantization for the quantized bench/serve path.
+
+Per-output-channel symmetric quantization: ``w ≈ wq * scale`` with
+``wq`` int8 and ``scale = max|w| / 127`` taken over every axis except
+the last (the output-feature axis of a dense kernel, the out-channel
+axis of an HWIO conv kernel).  Symmetric (no zero point) keeps the
+matmul a plain int8 contraction; per-channel scales keep the error
+proportional to each channel's own range.
+
+This is WEIGHT quantization only — the int8-weights bench mode rides
+the same compact-transfer idea as the PR 5 uint8 input plumbing: weights
+cross HBM (and, for the Pallas path, HBM→VMEM) at 1 byte/element and
+dequantize next to the compute (ops/pallas_fused.fused_dense_quantized
+dequantizes per tile in VMEM).  Activations stay float.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize_weight(w: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """``w [..., N] float`` -> ``(wq int8 same-shape, scale [N] f32)``.
+
+    Zero-range channels get scale 1 (their values are all exactly 0, so
+    any scale round-trips them)."""
+    w32 = w.astype(jnp.float32)
+    reduce_axes = tuple(range(w.ndim - 1))
+    amax = jnp.max(jnp.abs(w32), axis=reduce_axes)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    wq = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return wq, scale
+
+
+def dequantize_weight(wq: jax.Array, scale: jax.Array, dtype=jnp.float32) -> jax.Array:
+    return (wq.astype(jnp.float32) * scale.astype(jnp.float32)).astype(dtype)
+
+
+def _is_quantizable(path, leaf) -> bool:
+    """Quantize kernels only: rank >= 2 leaves whose name says 'kernel'.
+    Biases, norm scales/offsets, and BatchNorm stats stay float — they
+    are tiny, and quantizing a normalization parameter would scale the
+    activations themselves."""
+    name = str(getattr(path[-1], "key", getattr(path[-1], "name", path[-1])))
+    return name == "kernel" and getattr(leaf, "ndim", 0) >= 2
+
+
+def quantize_tree(params) -> tuple[dict, dict]:
+    """Split a param tree into int8 kernels + everything else.
+
+    Returns ``(quantized, passthrough)`` with identical tree structure
+    to ``params``: ``quantized`` holds ``{"wq": int8, "scale": f32}``
+    dicts at kernel positions and ``None`` elsewhere; ``passthrough``
+    holds the float leaves that were NOT quantized (None at kernel
+    positions).  ``dequantize_tree`` recombines them."""
+    quantized = {}
+    passthrough = {}
+
+    def visit(path, leaf):
+        if _is_quantizable(path, leaf):
+            wq, scale = quantize_weight(leaf)
+            # "like" is a zero-size array carrying the original dtype —
+            # an array (not a string) so the quantized tree can cross a
+            # jit boundary as a plain argument.
+            like = jnp.zeros((0,), getattr(leaf, "dtype", jnp.float32))
+            return {"wq": wq, "scale": scale, "like": like}, None
+        return None, leaf
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    q_leaves, p_leaves = zip(*(visit(p, l) for p, l in flat)) if flat else ((), ())
+    quantized = jax.tree_util.tree_unflatten(treedef, q_leaves)
+    passthrough = jax.tree_util.tree_unflatten(treedef, p_leaves)
+    return quantized, passthrough
+
+
+def dequantize_tree(quantized, passthrough):
+    """Inverse of :func:`quantize_tree`: reconstitute a float param tree
+    on device (jit this next to the apply so XLA schedules the upcast
+    where it is consumed)."""
+
+    def leaf(q, p):
+        if q is None:
+            return p
+        return dequantize_weight(q["wq"], q["scale"], dtype=q["like"].dtype)
+
+    return jax.tree_util.tree_map(
+        leaf, quantized, passthrough,
+        is_leaf=lambda v: v is None or (isinstance(v, dict) and "wq" in v),
+    )
+
+
+def quantized_nbytes(quantized) -> int:
+    """Device bytes of the int8 side (wq + scales) — the number the
+    bench reports against the float param footprint."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(quantized):
+        total += getattr(leaf, "nbytes", 0)
+    return total
+
+
+def tree_nbytes(params) -> int:
+    return sum(getattr(l, "nbytes", 0) for l in jax.tree_util.tree_leaves(params))

@@ -344,11 +344,23 @@ def device_put_batch(batch: Batch, sharding) -> tuple[jax.Array, jax.Array]:
 
 
 def _placed_with(leaf, sharding) -> bool:
-    """True when ``leaf`` is a committed jax.Array already laid out as
-    ``sharding`` — re-issuing device_put for it would at best be a no-op
-    and at worst a layout check on the hot path."""
+    """True when ``leaf`` is a LIVE committed jax.Array already laid out
+    as ``sharding`` — re-issuing device_put for it would at best be a
+    no-op and at worst a layout check on the hot path.
+
+    Liveness matters: a donated/deleted array keeps its sharding
+    metadata, so without the ``is_deleted`` check the skip would hand a
+    dead buffer back to the caller and the failure ("Array has been
+    deleted") would surface at first use, far from the placement site.
+    Treating deleted as not-placed makes ``jax.device_put`` raise right
+    here instead."""
     if not isinstance(leaf, jax.Array):
         return False
+    try:
+        if leaf.is_deleted():
+            return False
+    except AttributeError:
+        pass
     current = getattr(leaf, "sharding", None)
     if current is None:
         return False
@@ -371,6 +383,51 @@ def device_put_tree(tree, sharding):
         else jax.device_put(leaf, sharding),
         tree,
     )
+
+
+def stack_batches(batches: Iterator[Batch], k: int) -> Iterator[Batch]:
+    """Fold ``k`` consecutive host batches into one leading-axis stack:
+    ``Batch(x=[k, B, ...], y=[k, B, ...])`` — the pre-staged input shape
+    ``Trainer.multi_step_fn(k)`` scans over.  Stacking happens host-side
+    (numpy), BEFORE the DevicePrefetcher's ``device_put``, so a whole
+    k-step stack crosses PCIe as one transfer and lands device-resident
+    ahead of the dispatch that consumes it.  A trailing ragged group
+    (fewer than ``k`` batches left) is NOT yielded — callers route the
+    remainder through the single-step path."""
+    if k < 1:
+        raise ValueError(f"stack_batches needs k >= 1, got {k}")
+    group: list[Batch] = []
+    for b in batches:
+        group.append(b)
+        if len(group) == k:
+            yield Batch(
+                x=jax.tree_util.tree_map(lambda *ls: np.stack(ls), *[g.x for g in group]),
+                y=jax.tree_util.tree_map(lambda *ls: np.stack(ls), *[g.y for g in group]),
+            )
+            group = []
+
+
+def donate_buffers(tree) -> int:
+    """Explicitly free the device buffers of a consumed batch tree and
+    return the bytes released.
+
+    XLA donation is strictly input->output aliasing, and a training
+    batch has no same-shaped output to alias into — ``donate_argnums``
+    on the batch operands would only emit "donated buffers were not
+    usable" warnings and free nothing.  So batch "donation" is this:
+    the loop deletes the buffers it placed itself as soon as the step
+    consuming them has been dispatched.  Deletion is safe in-flight
+    (the runtime holds execution references until the step completes);
+    what it guarantees is that the NEXT prefetched batch never waits on
+    HBM still pinned by an already-consumed one.  Only call this on
+    buffers the caller placed — never on arrays handed in from outside
+    the loop."""
+    freed = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array) and not leaf.is_deleted():
+            freed += leaf.nbytes
+            leaf.delete()
+    return freed
 
 
 class DevicePrefetcher:
@@ -519,6 +576,15 @@ class DevicePrefetcher:
                 yield item
         finally:
             self.close()
+
+    def buffered(self) -> list[Batch]:
+        """Snapshot of the batches currently staged ahead of the
+        consumer — each already device-resident (the producer issued its
+        ``device_put`` before inserting).  Introspection for structural
+        overlap checks (scripts/perf_smoke.py asserts the double buffer
+        actually holds >= 2 device batches); not part of the hot loop."""
+        with self._cond:
+            return [b for b in self._buf.values() if isinstance(b, Batch)]
 
     def close(self) -> None:
         self._stop.set()

@@ -368,16 +368,25 @@ class Trainer:
     def init(self, rng: jax.Array, sample_x: jax.Array) -> TrainState:
         """Initialize params/opt-state and place them on the mesh."""
         init_kwargs = {"train": False} if self.config.has_train_arg else {}
+
         # The model sees what the train step feeds it: the augment stage
         # runs first (margin records crop stored-size inputs down to the
         # model size — models with flatten heads need the cropped shape
         # at init), then uint8 batches (input_stats) normalize in-step.
-        sample = jnp.asarray(sample_x[:1])
-        if self.config.augment is not None:
-            sample = self.config.augment(jnp.zeros((), jnp.int32), sample)
-        sample = self._normalize_input(sample)
+        # Composed INSIDE the traced init (and inside eval_shape below),
+        # never eagerly: an eager slice/dequantize here dispatches tiny
+        # one-off programs that read as retraces in the bench's compile
+        # watcher.  The sample aval is built symbolically for the same
+        # reason.
+        def _prep(sample):
+            sample = sample[:1]
+            if self.config.augment is not None:
+                sample = self.config.augment(jnp.zeros((), jnp.int32), sample)
+            return self._normalize_input(sample)
+
+        sample_aval = jax.ShapeDtypeStruct(tuple(sample_x.shape), sample_x.dtype)
         variables = jax.eval_shape(
-            partial(self.model.init, rng, **init_kwargs), sample
+            lambda r, s: self.model.init(r, _prep(s), **init_kwargs), rng, sample_aval
         )
         abstract_params = variables["params"]
         abstract_model_state = {k: v for k, v in variables.items() if k != "params"}
@@ -402,7 +411,7 @@ class Trainer:
 
         @partial(jax.jit, out_shardings=self.state_shardings)
         def _init(rng, sample):
-            variables = self.model.init(rng, sample, **init_kwargs)
+            variables = self.model.init(rng, _prep(sample), **init_kwargs)
             params = variables["params"]
             model_state = {k: v for k, v in variables.items() if k != "params"}
             return TrainState(
@@ -412,7 +421,7 @@ class Trainer:
                 model_state=model_state,
             )
 
-        return _init(rng, sample)
+        return _init(rng, sample_x)
 
     def _opt_state_shardings(
         self, abstract_params: Any, param_sh: Any, mesh: Mesh | None = None
@@ -532,6 +541,11 @@ class Trainer:
         the candidate savings are param/optimizer re-reads, which are
         <1% of the step's HBM traffic — activation bytes dominate and
         are batch-unique, so no cross-iteration reuse exists for them.
+        The real win is on the HOST side: one dispatch (and one
+        pre-staged input stack) per k steps.  ``fit(steps_per_call=k)``
+        feeds this program double-buffered device-resident stacks and
+        frees each consumed stack right after dispatch
+        (docs/PERFORMANCE.md, "the overlap architecture").
         """
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
@@ -742,6 +756,7 @@ class Trainer:
         prefetch_workers: int = 1,
         reshard: Any = None,
         profiler: Any = None,
+        steps_per_call: int = 1,
     ) -> tuple[TrainState, list[float]]:
         """``stop_fn(metrics) -> True`` ends training early — the
         time-to-accuracy mode (the reference's only published CIFAR metric
@@ -791,10 +806,43 @@ class Trainer:
         there), so nothing about the dispatch pipeline changes when
         profiling is on.  NOTE: the first step's interval includes
         compile — read p50, not max, for steady-state.
+
+        ``steps_per_call`` > 1 routes through ``multi_step_fn(k)``: k
+        host batches are stacked host-side, prefetched device-resident
+        as ONE pre-staged stack, dispatched as one scanned program, and
+        the consumed stack's buffers are explicitly freed (donated)
+        right after dispatch — the overlap architecture
+        docs/PERFORMANCE.md describes.  Semantically identical to k
+        single-step dispatches (tests pin bit-parity); incompatible
+        with ``reshard`` (the scan body cannot pause at an inner step
+        boundary).  A ``steps % k`` remainder runs via the single-step
+        path on the same batch iterator.
         """
         from deeplearning_cfn_tpu.obs.profiler import NULL_PROFILER
         from deeplearning_cfn_tpu.train.data import DevicePrefetcher
         from deeplearning_cfn_tpu.train.pipeline import PipelineStats
+
+        if steps_per_call < 1:
+            raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
+        if steps_per_call > 1:
+            if reshard is not None:
+                raise ValueError(
+                    "steps_per_call > 1 is incompatible with live resharding: "
+                    "the scanned multi-step program cannot pause at an inner "
+                    "step boundary — use steps_per_call=1 for elastic runs"
+                )
+            return self._fit_multi(
+                state,
+                batches,
+                steps,
+                steps_per_call,
+                logger=logger,
+                checkpointer=checkpointer,
+                stop_fn=stop_fn,
+                prefetch=prefetch,
+                prefetch_workers=prefetch_workers,
+                profiler=profiler,
+            )
 
         prof = profiler if profiler is not None else NULL_PROFILER
 
@@ -889,6 +937,132 @@ class Trainer:
             if prefetcher is not None:
                 prefetcher.close()
         losses.extend(float(v) for v in jax.device_get(pending))
+        return state, losses
+
+    def _fit_multi(
+        self,
+        state: TrainState,
+        batches,
+        steps: int,
+        k: int,
+        logger: ThroughputLogger | None = None,
+        checkpointer: Any = None,
+        stop_fn: Callable[[dict], bool] | None = None,
+        prefetch: int = 2,
+        prefetch_workers: int = 1,
+        profiler: Any = None,
+    ) -> tuple[TrainState, list[float]]:
+        """The ``steps_per_call=k`` loop: stacked, pre-staged, donated.
+
+        Per outer iteration ONE ``multi_step_fn(k)`` dispatch consumes a
+        ``[k, B, ...]`` batch stack the prefetcher already put on device
+        (H2D overlapped with the previous call's compute), and the
+        consumed stack is freed immediately after dispatch — deletion is
+        safe in-flight, and it keeps at most ``prefetch`` stacks of HBM
+        live instead of letting dead inputs pile up behind the dispatch
+        queue.  Stop/checkpoint/log granularity is the k-step call.
+        """
+        from deeplearning_cfn_tpu.obs.profiler import NULL_PROFILER
+        from deeplearning_cfn_tpu.train.data import (
+            DevicePrefetcher,
+            donate_buffers,
+            stack_batches,
+        )
+        from deeplearning_cfn_tpu.train.pipeline import PipelineStats
+
+        prof = profiler if profiler is not None else NULL_PROFILER
+        kfn = self.multi_step_fn(k)  # built ONCE; call-many below
+        stacked_sharding = NamedSharding(
+            self.mesh, P(None, *self.batch_sharding.spec)
+        )
+        losses: list[float] = []
+        pending: list[jax.Array] = []  # device [k] loss vectors
+        sync_every = max(1, -(-int(self.config.log_every) // k))  # in calls
+        t_fit = time.perf_counter()
+        first_done = False
+        stopped = False
+        batches = itertools.islice(batches, steps)
+        calls = steps // k
+        stacked = stack_batches(itertools.islice(batches, calls * k), k)
+        prefetcher: DevicePrefetcher | None = None
+        self.last_pipeline_stats = stats = PipelineStats(name="fit")
+        if prefetch > 0:
+            stacked = prefetcher = DevicePrefetcher(
+                stacked,
+                stacked_sharding,
+                prefetch,
+                workers=prefetch_workers,
+                stats=stats,
+                profiler=profiler,
+            )
+        stacked = prof.wrap_source(stacked)
+        gstep = int(jax.device_get(state.step))
+        prof.start()
+        try:
+            for i, stack in enumerate(stacked):
+                with span("train_step"):
+                    with prof.phase("h2d"):
+                        # Prefetched stacks are already resident with the
+                        # stacked sharding — this is an identity check.
+                        xs = device_put_tree(stack.x, stacked_sharding)
+                        ys = device_put_tree(stack.y, stacked_sharding)
+                    with prof.phase("dispatch"):
+                        with set_mesh(self.mesh):
+                            state, kloss = kfn(state, xs, ys)
+                    # The stack was built host-side by stack_batches and
+                    # placed by this loop/prefetcher, so it is ours to
+                    # free.  XLA can't donate it (no same-shaped output to
+                    # alias into), hence the explicit delete — see
+                    # train/data.donate_buffers.
+                    donate_buffers((xs, ys))
+                gstep += k
+                pending.append(kloss)
+                if not first_done:
+                    first_done = True
+                    with prof.sync_boundary():
+                        jax.block_until_ready(kloss)
+                    self.first_step_seconds = time.perf_counter() - t_fit
+                    self.first_step_at = time.perf_counter()
+                if logger:
+                    logger.step(gstep, kloss[-1])
+                if checkpointer is not None and checkpointer.should_save(gstep):
+                    with span("checkpoint", step=gstep):
+                        checkpointer.save(gstep, state)
+                if (i + 1) % sync_every == 0 or i == calls - 1:
+                    with prof.sync_boundary(len(pending) * k):
+                        for vec in jax.device_get(pending):
+                            losses.extend(float(v) for v in vec)
+                    pending.clear()
+                    if stop_fn is not None and stop_fn({"loss": losses[-1]}):
+                        stopped = True
+                        break
+                prof.step_done(step=gstep, steps=k)
+        finally:
+            if prefetcher is not None:
+                prefetcher.close()
+        for vec in jax.device_get(pending):
+            losses.extend(float(v) for v in vec)
+        pending = []
+        # Ragged tail (steps % k): the remaining batches run through the
+        # ordinary single-step program — same raw step body, so the loss
+        # sequence is seamless.
+        if not stopped and steps % k:
+            step_fn = self.step_fn
+            scalar_pending: list[jax.Array] = []
+            for batch in batches:
+                with span("train_step"):
+                    with prof.phase("h2d"):
+                        x = device_put_tree(batch.x, self.batch_sharding)
+                        y = device_put_tree(batch.y, self.batch_sharding)
+                    with prof.phase("dispatch"):
+                        with set_mesh(self.mesh):
+                            state, metrics = step_fn(state, x, y)
+                gstep += 1
+                scalar_pending.append(metrics["loss"])
+                if logger:
+                    logger.step(gstep, metrics["loss"])
+                prof.step_done(step=gstep)
+            losses.extend(float(v) for v in jax.device_get(scalar_pending))
         return state, losses
 
     # --- compile diagnostics ---------------------------------------------

@@ -37,6 +37,20 @@ TRACKED: tuple[tuple[str, str, bool], ...] = (
 EPSILON = 0.005
 
 
+def mode_regression(old: dict, new: dict) -> str | None:
+    """A round falling out of the scanned multi-step dispatch mode back
+    to single_step is a qualitative regression no numeric diff shows
+    (the headline throughput may barely move on a lucky draw, but the
+    overlap architecture stopped winning).  Returns the verdict fragment
+    to fold into the headline, or None."""
+    a, b = old.get("mode"), new.get("mode")
+    if not isinstance(a, str) or not isinstance(b, str):
+        return None
+    if a.startswith("multi_step") and b == "single_step":
+        return f"mode regressed ({a} -> {b})"
+    return None
+
+
 def bench_rounds(root: Path) -> list[Path]:
     """BENCH_r*.json sorted by round number (the filename's integer,
     not mtime — re-checkouts touch everything)."""
@@ -94,9 +108,17 @@ def main(argv: list[str] | None = None) -> int:
         lines.append(line)
         if verdict in ("improved", "regressed", "flat"):
             verdicts.append((label, verdict))
+    if isinstance(old.get("mode"), str) or isinstance(new.get("mode"), str):
+        lines.append(f"  mode: {old.get('mode')} -> {new.get('mode')}")
     regressed = [label for label, v in verdicts if v == "regressed"]
     improved = [label for label, v in verdicts if v == "improved"]
-    if regressed:
+    mode_note = mode_regression(old, new)
+    if mode_note:
+        # Name the dispatch-mode fallback explicitly: losing multi_step is
+        # a regression even when every numeric metric reads flat.
+        extra = f", {', '.join(regressed)}" if regressed else ""
+        headline = f"REGRESSED ({mode_note}{extra})"
+    elif regressed:
         headline = f"REGRESSED ({', '.join(regressed)})"
     elif improved:
         headline = f"improved ({', '.join(improved)})"

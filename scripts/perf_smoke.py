@@ -14,9 +14,11 @@ docs/PERFORMANCE.md covers how to read the timing counters it prints.
 A serving-plane scheduler stage, a 1k-agent broker-failover soak (both
 on virtual clocks, structural asserts only), a fleet-telemetry payload
 cost check (TELEM snapshots stay O(entries) with summaries truncated at
-the wire cap), and an exact-match check of the audited train step's
-collective bytes against the committed comms budget (8-virtual-device
-runs only) ride along.
+the wire cap), an input-overlap stage (double-buffered stacked batches
+stay >= 2 deep on device, consumed stacks are freed by donate_buffers,
+and the consumer holds its single post-warmup compile), and an
+exact-match check of the audited train step's collective bytes against
+the committed comms budget (8-virtual-device runs only) ride along.
 
 Exit 0 and one JSON line on success; exit 1 with a message on violation.
 """
@@ -327,6 +329,116 @@ def telemetry_overhead() -> tuple[dict, list[str]]:
     }, failures
 
 
+OVERLAP_K = 2        # batches per stacked multi-step call
+OVERLAP_CALLS = 5    # stacks consumed by the stage
+OVERLAP_BUFFER = 2   # DevicePrefetcher depth — the double buffer
+
+
+def input_overlap() -> tuple[dict, list[str]]:
+    """Overlap-architecture stage: structural asserts only, no wall-clock.
+
+    Drives stacked uint8 batches through ``DevicePrefetcher`` exactly the
+    way ``Trainer._fit_multi`` and the bench multi-step phase do, and
+    checks the three properties docs/PERFORMANCE.md's overlap section
+    promises: (1) the prefetcher keeps >= 2 batches device-resident
+    while one is being consumed (double buffering, observed via
+    ``buffered()``); (2) every consumed stack's leaves are actually
+    freed by ``donate_buffers`` (``is_deleted``) — the explicit-delete
+    stand-in for donation on input stacks; (3) the consuming program
+    compiles once and never again across the remaining same-shape calls
+    (zero post-warmup compiles)."""
+    import time
+
+    from deeplearning_cfn_tpu.analysis.compile_audit import CompileWatcher
+    from deeplearning_cfn_tpu.train.data import (
+        DevicePrefetcher,
+        SyntheticDataset,
+        donate_buffers,
+        stack_batches,
+    )
+
+    failures: list[str] = []
+    ds = SyntheticDataset(
+        shape=(IMAGE, IMAGE, 3), num_classes=10, batch_size=BATCH, dtype="uint8"
+    )
+    sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+
+    @jax.jit
+    def consume(xs, ys):
+        return jnp.sum(xs.astype(jnp.float32)) + jnp.sum(ys)
+
+    stacks = stack_batches(ds.batches(OVERLAP_CALLS * OVERLAP_K), OVERLAP_K)
+    prefetcher = DevicePrefetcher(
+        stacks, sharding, size=OVERLAP_BUFFER, workers=WORKERS
+    )
+    peak_resident = 0
+    donated_bytes = 0
+    calls = 0
+    out = None
+    try:
+        with CompileWatcher() as watcher:
+            for i, stack in enumerate(prefetcher):
+                if i == 0:
+                    # Let the producer refill behind the in-hand stack so
+                    # the double buffer is observable, then freeze the
+                    # compile ledger: everything past this call is steady
+                    # state.
+                    deadline = time.monotonic() + 10.0
+                    while (
+                        len(prefetcher.buffered()) < OVERLAP_BUFFER
+                        and time.monotonic() < deadline
+                    ):
+                        time.sleep(0.001)
+                peak_resident = max(peak_resident, 1 + len(prefetcher.buffered()))
+                out = consume(stack.x, stack.y)
+                if i == 0:
+                    out.block_until_ready()
+                    watcher.mark_steady()
+                # Explicit free of the consumed stack — deletion after
+                # dispatch is safe (the running program holds its own
+                # reference) and is what keeps k-deep stacks from
+                # accumulating in HBM.
+                donated_bytes += donate_buffers((stack.x, stack.y))
+                if not (stack.x.is_deleted() and stack.y.is_deleted()):
+                    failures.append(
+                        "consumed stack leaves survive donate_buffers "
+                        "(is_deleted False) — stacks would accumulate in HBM"
+                    )
+                calls += 1
+            out.block_until_ready()
+            retraces = watcher.new_compiles_since_mark()
+    finally:
+        prefetcher.close()
+    if calls != OVERLAP_CALLS:
+        failures.append(
+            f"overlap stage consumed {calls} stacks, expected {OVERLAP_CALLS}"
+        )
+    if peak_resident < 2:
+        failures.append(
+            f"prefetcher never held 2 device-resident stacks "
+            f"(peak {peak_resident}) — no overlap to hide transfers behind"
+        )
+    if retraces:
+        failures.append(
+            f"overlap consumer recompiled after warmup: {sorted(retraces)}"
+        )
+    expected_stack_bytes = OVERLAP_CALLS * OVERLAP_K * BATCH * (
+        IMAGE * IMAGE * 3 + 4
+    )
+    if donated_bytes != expected_stack_bytes:
+        failures.append(
+            f"donated bytes {donated_bytes} != expected {expected_stack_bytes} "
+            "(uint8 images + int32 labels across every consumed stack)"
+        )
+    return {
+        "steps_per_call": OVERLAP_K,
+        "calls": calls,
+        "device_resident_stacks_peak": peak_resident,
+        "donated_bytes": donated_bytes,
+        "post_warmup_compiles": len(retraces),
+    }, failures
+
+
 BROKER_SOAK_AGENTS = 1000
 BROKER_SOAK_SENDERS = 100
 
@@ -460,6 +572,9 @@ def main() -> int:
         if phase not in snap["phases"]:
             failures.append(f"profiler snapshot missing phase {phase!r}")
 
+    overlap_snap, overlap_failures = input_overlap()
+    failures.extend(overlap_failures)
+
     serve_snap, serve_failures = serve_scheduler()
     failures.extend(serve_failures)
 
@@ -490,6 +605,7 @@ def main() -> int:
                     for k in ("bare_s", "profiled_s", "overhead_fraction")
                 },
                 "step_ms": snap["step_ms"],
+                "overlap": overlap_snap,
                 "serve": serve_snap,
                 "broker_failover": broker_snap,
                 "telemetry": telem_snap,

@@ -1,0 +1,94 @@
+"""scripts/bench_compare.py: the mode-regression verdict and its
+warn-only contract.
+
+The satellite this pins: a round that falls out of the scanned
+multi-step dispatch mode (``mode: multi_step_k*``) back to
+``single_step`` must be NAMED in the one-line verdict even when every
+numeric metric is flat — and the exit code must stay 0 (trajectory
+guard, not a gate).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+_SCRIPT = Path(__file__).resolve().parent.parent / "scripts" / "bench_compare.py"
+_spec = importlib.util.spec_from_file_location("bench_compare", _SCRIPT)
+bench_compare = importlib.util.module_from_spec(_spec)
+sys.modules.setdefault("bench_compare", bench_compare)
+_spec.loader.exec_module(bench_compare)
+
+
+def _write_round(root: Path, n: int, parsed: dict) -> Path:
+    path = root / f"BENCH_r{n:02d}.json"
+    path.write_text(json.dumps({"parsed": parsed}))
+    return path
+
+
+def test_mode_regression_named_in_headline(tmp_path, capsys):
+    """multi_step_k4 -> single_step: headline names the mode regression
+    even though every numeric metric is byte-identical (flat)."""
+    metrics = {"mfu": 0.41, "value": 400.0, "vs_baseline": 1.14}
+    _write_round(tmp_path, 6, {**metrics, "mode": "multi_step_k4"})
+    _write_round(tmp_path, 7, {**metrics, "mode": "single_step"})
+    rc = bench_compare.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0  # warn-only, even on a named regression
+    headline = out.splitlines()[0]
+    assert "REGRESSED" in headline
+    assert "multi_step_k4 -> single_step" in headline
+    assert "mode: multi_step_k4 -> single_step" in out
+
+
+def test_mode_regression_joined_with_metric_regressions(tmp_path, capsys):
+    _write_round(tmp_path, 1, {"mfu": 0.41, "mode": "multi_step_k4"})
+    _write_round(tmp_path, 2, {"mfu": 0.30, "mode": "single_step"})
+    rc = bench_compare.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    headline = out.splitlines()[0]
+    assert "multi_step_k4 -> single_step" in headline
+    assert "MFU" in headline
+
+
+@pytest.mark.parametrize(
+    "old_mode,new_mode",
+    [
+        ("multi_step_k4", "multi_step_k4"),  # stable multi-step
+        ("multi_step_k4", "multi_step_k8"),  # still multi-step
+        ("single_step", "single_step"),      # never left single-step
+        ("single_step", "multi_step_k4"),    # an upgrade, not a regression
+        (None, "single_step"),               # old round predates mode labels
+        ("multi_step_k4", None),             # new round lost the label: not a
+                                             # claimed single_step fallback
+    ],
+)
+def test_no_false_positive(tmp_path, capsys, old_mode, new_mode):
+    metrics = {"mfu": 0.41}
+    old = dict(metrics)
+    new = dict(metrics)
+    if old_mode is not None:
+        old["mode"] = old_mode
+    if new_mode is not None:
+        new["mode"] = new_mode
+    _write_round(tmp_path, 1, old)
+    _write_round(tmp_path, 2, new)
+    rc = bench_compare.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "REGRESSED" not in out.splitlines()[0]
+
+
+def test_mode_regression_helper_direct():
+    f = bench_compare.mode_regression
+    assert f({"mode": "multi_step_k2"}, {"mode": "single_step"}) == (
+        "mode regressed (multi_step_k2 -> single_step)"
+    )
+    assert f({}, {"mode": "single_step"}) is None
+    assert f({"mode": "multi_step_k2"}, {}) is None
+    assert f({"mode": 4}, {"mode": "single_step"}) is None

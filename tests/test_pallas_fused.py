@@ -1,0 +1,280 @@
+"""Pallas fused dense: bit-parity with the XLA reference, gradients,
+the int8-weights variant, tree quantization, profitability dispatch, and
+the flag-gated model wiring (FusedDense / BERT MLP / ResNet head).
+
+The parity contract is BIT-IDENTITY (np.array_equal, not allclose)
+against the JITTED reference: both programs accumulate in f32 on the
+same operand order, so any divergence means the kernel's math drifted
+from the fallback path a model takes with its flag off.  Comparisons
+must be against ``jax.jit(fused_dense_reference)`` — the eager gelu
+differs from its jitted self by ~5e-7, which is XLA fusion, not us.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_cfn_tpu.ops.pallas_fused import (
+    _quant_reference,
+    fused_dense,
+    fused_dense_bytes,
+    fused_dense_profitable,
+    fused_dense_quantized,
+    fused_dense_reference,
+)
+from deeplearning_cfn_tpu.ops.quant import (
+    dequantize_tree,
+    quantize_tree,
+    quantized_nbytes,
+    quantize_weight,
+    tree_nbytes,
+)
+
+
+def _operands(m, k, n, dtype, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((m, k)), dtype)
+    w = jnp.asarray(rng.standard_normal((k, n)) * 0.1, dtype)
+    b = jnp.asarray(rng.standard_normal((n,)) * 0.1, dtype)
+    return x, w, b
+
+
+# --- forward parity -----------------------------------------------------------
+
+
+@pytest.mark.parametrize("activation", [None, "relu", "gelu"])
+@pytest.mark.parametrize(
+    "m,k,n",
+    [
+        (16, 128, 128),   # exactly one tile
+        (48, 96, 200),    # every dim needs padding, two N tiles
+        (3, 7, 5),        # tiny, heavily padded
+        (16, 256, 128),   # two K lanes, one reduction chunk
+    ],
+)
+def test_forward_bit_identical_to_jitted_reference(m, k, n, activation):
+    for dtype in (jnp.float32, jnp.bfloat16):
+        x, w, b = _operands(m, k, n, dtype)
+        got = jax.jit(
+            lambda x, w, b: fused_dense(x, w, b, activation=activation)
+        )(x, w, b)
+        want = jax.jit(
+            lambda x, w, b: fused_dense_reference(x, w, b, activation=activation)
+        )(x, w, b)
+        assert got.dtype == want.dtype == dtype
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_forward_close_at_thread_partitioned_shapes():
+    """At shapes big enough for XLA's CPU backend to partition the dot
+    across its intra-op thread pool (partitioning depends on the virtual
+    device count, so this shifts under --xla_force_host_platform_device_count),
+    the REFERENCE's own f32 summation order changes and bit-identity
+    with it is no longer defined.  The kernel must still agree to f32
+    accumulation tolerance.  On real TPUs both run the MXU reduction
+    order and the bit contract is checked by the small-shape cases."""
+    x, w, b = _operands(64, 256, 384, jnp.float32)
+    got = jax.jit(lambda x, w, b: fused_dense(x, w, b, activation="gelu"))(x, w, b)
+    want = jax.jit(
+        lambda x, w, b: fused_dense_reference(x, w, b, activation="gelu")
+    )(x, w, b)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_input_validation():
+    x, w, b = _operands(8, 16, 4, jnp.float32)
+    with pytest.raises(ValueError, match="unknown activation"):
+        fused_dense(x, w, b, activation="swish")
+    with pytest.raises(ValueError, match="wants x"):
+        fused_dense(x[None], w, b)
+
+
+# --- gradients ----------------------------------------------------------------
+
+
+@pytest.mark.parametrize("activation", [None, "relu", "gelu"])
+def test_grads_match_reference(activation):
+    x, w, b = _operands(16, 64, 32, jnp.float32, seed=1)
+
+    def loss_fused(x, w, b):
+        return jnp.sum(fused_dense(x, w, b, activation=activation) ** 2)
+
+    def loss_ref(x, w, b):
+        return jnp.sum(fused_dense_reference(x, w, b, activation=activation) ** 2)
+
+    g_fused = jax.jit(jax.grad(loss_fused, argnums=(0, 1, 2)))(x, w, b)
+    g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(x, w, b)
+    for a, r in zip(g_fused, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(r), rtol=1e-5, atol=1e-6
+        )
+
+
+# --- int8-weights variant -----------------------------------------------------
+
+
+@pytest.mark.parametrize("activation", [None, "gelu"])
+def test_quantized_bit_identical_to_reference(activation):
+    x, w, b = _operands(24, 96, 48, jnp.float32, seed=2)
+    wq, scale = quantize_weight(w)
+    got = fused_dense_quantized(x, wq, scale, b, activation=activation)
+    want = jax.jit(
+        lambda x, wq, s, b: _quant_reference(x, wq, s, b, activation, x.dtype)
+    )(x, wq, scale, b)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_quantized_rejects_float_weights():
+    x, w, b = _operands(8, 16, 4, jnp.float32)
+    with pytest.raises(ValueError, match="int8"):
+        fused_dense_quantized(x, w, jnp.ones((4,)), b)
+
+
+def test_quantize_weight_roundtrip_error_bounded():
+    _, w, _ = _operands(8, 64, 32, jnp.float32, seed=3)
+    wq, scale = quantize_weight(w)
+    assert wq.dtype == jnp.int8 and scale.shape == (32,)
+    back = np.asarray(wq, np.float32) * np.asarray(scale)
+    # Symmetric int8: error bounded by half a quantization step per channel.
+    np.testing.assert_allclose(
+        back, np.asarray(w), atol=float(np.asarray(scale).max()) * 0.51
+    )
+    # Zero-range channels round-trip exactly (scale forced to 1).
+    wq0, s0 = quantize_weight(jnp.zeros((4, 4)))
+    assert np.asarray(s0).tolist() == [1.0] * 4
+    assert np.asarray(wq0).sum() == 0
+
+
+# --- tree quantization --------------------------------------------------------
+
+
+def _param_tree():
+    rng = np.random.default_rng(4)
+    return {
+        "dense": {
+            "kernel": jnp.asarray(rng.standard_normal((32, 16)), jnp.float32),
+            "bias": jnp.zeros((16,), jnp.float32),
+        },
+        "norm": {"scale": jnp.ones((16,), jnp.float32)},
+    }
+
+
+def test_quantize_tree_roundtrip_and_structure():
+    params = _param_tree()
+    quantized, passthrough = quantize_tree(params)
+    # Kernel positions carry the int8 record; everything else passes through.
+    assert quantized["dense"]["kernel"]["wq"].dtype == jnp.int8
+    assert quantized["dense"]["bias"] is None
+    assert passthrough["dense"]["kernel"] is None
+    assert passthrough["norm"]["scale"] is params["norm"]["scale"]
+    back = dequantize_tree(quantized, passthrough)
+    assert jax.tree_util.tree_structure(back) == jax.tree_util.tree_structure(params)
+    # Non-kernel leaves come back exactly; kernels within quantization error.
+    np.testing.assert_array_equal(
+        np.asarray(back["dense"]["bias"]), np.asarray(params["dense"]["bias"])
+    )
+    np.testing.assert_allclose(
+        np.asarray(back["dense"]["kernel"]),
+        np.asarray(params["dense"]["kernel"]),
+        atol=0.05,
+    )
+    assert back["dense"]["kernel"].dtype == params["dense"]["kernel"].dtype
+
+
+def test_quantize_tree_crosses_jit_boundary():
+    """The quantized tree must be a valid jit argument (the bench jits
+    quantize_tree and the int8 forward): no strings, no Python scalars —
+    the dtype rides in a zero-size "like" array."""
+    params = _param_tree()
+    quantized, passthrough = jax.jit(quantize_tree)(params)
+    back = jax.jit(dequantize_tree)(quantized, passthrough)
+    assert back["dense"]["kernel"].dtype == jnp.float32
+
+
+def test_quantized_nbytes_is_compact():
+    params = _param_tree()
+    quantized, _ = quantize_tree(params)
+    q = quantized_nbytes(quantized)
+    total = tree_nbytes(params)
+    kernel_f32 = 32 * 16 * 4
+    # int8 kernel + f32 scales + empty "like": ~1/4 the float kernel.
+    assert q == 32 * 16 + 16 * 4
+    assert q < kernel_f32
+    assert total == kernel_f32 + 16 * 4 + 16 * 4
+
+
+# --- profitability dispatch ---------------------------------------------------
+
+
+def test_profitability_returns_bool_and_bytes_formula():
+    verdict = fused_dense_profitable(256, 512, 512)
+    assert isinstance(verdict, bool)
+    # Analytic traffic: read x + w + b once, write out once.
+    assert fused_dense_bytes(4, 8, 16, 2) == 2 * (4 * 8 + 8 * 16 + 16 + 4 * 16)
+
+
+# --- model wiring -------------------------------------------------------------
+
+
+def test_fused_dense_module_matches_nn_dense():
+    """FusedDense is checkpoint-compatible with nn.Dense: identical
+    param tree (names, shapes, dtypes, init values) and identical output
+    at f32 — a model can flip its use_pallas_* flag on an existing
+    checkpoint and restore in either direction."""
+    import flax.linen as nn
+
+    from deeplearning_cfn_tpu.models.fused_layers import FusedDense
+
+    x = jnp.asarray(np.random.default_rng(5).standard_normal((4, 32)), jnp.float32)
+    ref = nn.Dense(16)
+    fused = FusedDense(16)
+    v_ref = ref.init(jax.random.key(0), x)
+    v_fused = fused.init(jax.random.key(0), x)
+    assert jax.tree_util.tree_structure(v_ref) == jax.tree_util.tree_structure(v_fused)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(v_ref), jax.tree_util.tree_leaves(v_fused)
+    ):
+        assert a.shape == b.shape and a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    out_ref = jax.jit(ref.apply)(v_ref, x)
+    out_fused = jax.jit(fused.apply)(v_ref, x)  # reference params, fused math
+    np.testing.assert_array_equal(np.asarray(out_ref), np.asarray(out_fused))
+
+
+def test_bert_pallas_mlp_flag_is_a_noop_numerically():
+    import dataclasses
+
+    from deeplearning_cfn_tpu.models.bert import BertConfig, BertEncoder
+
+    rng = np.random.default_rng(6)
+    tok = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)
+    cfg = BertConfig.tiny(vocab_size=64, seq_len=16)
+    off = BertEncoder(cfg)
+    on = BertEncoder(dataclasses.replace(cfg, use_pallas_mlp=True))
+    v = off.init(jax.random.key(0), tok)
+    assert jax.tree_util.tree_structure(v) == jax.tree_util.tree_structure(
+        on.init(jax.random.key(0), tok)
+    )
+    out_off = jax.jit(off.apply)(v, tok)
+    out_on = jax.jit(on.apply)(v, tok)
+    np.testing.assert_array_equal(np.asarray(out_off), np.asarray(out_on))
+
+
+def test_resnet_pallas_head_flag_is_a_noop_numerically():
+    from deeplearning_cfn_tpu.models.resnet import ResNet
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((2, 32, 32, 3)), jnp.float32)
+    kwargs = dict(stage_sizes=(1,), num_filters=8, num_classes=4)
+    off = ResNet(**kwargs)
+    on = ResNet(**kwargs, use_pallas_head=True)
+    v = off.init(jax.random.key(0), x, train=False)
+    assert jax.tree_util.tree_structure(v["params"]) == jax.tree_util.tree_structure(
+        on.init(jax.random.key(0), x, train=False)["params"]
+    )
+    out_off = jax.jit(lambda v, x: off.apply(v, x, train=False))(v, x)
+    out_on = jax.jit(lambda v, x: on.apply(v, x, train=False))(v, x)
+    np.testing.assert_array_equal(np.asarray(out_off), np.asarray(out_on))

@@ -266,3 +266,19 @@ def test_program_attribution_handles_missing_cost():
         flops=None, bytes_accessed=None, seconds_per_call=0.01, peak_flops=1e12
     )
     assert "mfu" not in out and "flops_per_step" not in out
+
+
+def test_labels_ride_snapshot_only_when_set():
+    prof = StepProfiler(name="labeled", clock=VirtualClock())
+    assert "labels" not in prof.snapshot()  # unset -> absent, not {}
+    prof.set_label("mode", "multi_step_k4")
+    prof.set_label("k", 4)
+    snap = prof.snapshot()
+    assert snap["labels"] == {"mode": "multi_step_k4", "k": 4}
+    # Re-setting overwrites; snapshot holds a copy, not the live dict.
+    prof.set_label("mode", "single_step")
+    assert snap["labels"]["mode"] == "multi_step_k4"
+    assert prof.snapshot()["labels"]["mode"] == "single_step"
+    # The disabled profiler swallows labels like every other call.
+    NULL_PROFILER.set_label("mode", "x")
+    assert "labels" not in NULL_PROFILER.snapshot()
