@@ -501,6 +501,12 @@ def main() -> None:
                 "mfu": round(mfu, 4) if mfu is not None else None,
                 "mode": mode,
                 "mode_reason": mode_reason,
+                # What fed the step loop: "synthetic" (in-memory generated
+                # batches) vs "records" (the train/datastream DLC1 shard
+                # path).  Throughput numbers are only comparable within
+                # one input mode — bench_compare refuses to diff across
+                # them.
+                "input_mode": "synthetic",
                 "transfer_dtype": "uint8",
                 "single_step_images_per_sec_per_chip": round(
                     single_step_per_chip, 2

@@ -15,9 +15,10 @@ Seams covered:
 * :class:`ChaosQueue` — any :class:`RendezvousQueue`: message drop,
   delay, duplication, and reorder, the SQS pathologies consumers must
   already tolerate.
-* :class:`TornDisk` / :class:`SlowDisk` — checkpoint ``CheckpointIO``:
-  torn writes (a prefix lands, then OSError) and high-latency disks on
-  virtual time.
+* :class:`TornDisk` / :class:`SlowDisk` / :class:`ManifestCrashDisk` —
+  checkpoint ``CheckpointIO``: torn writes (a prefix lands, then
+  OSError), high-latency disks on virtual time, and a writer crash at
+  the async sharded checkpointer's manifest commit point.
 """
 
 from __future__ import annotations
@@ -264,6 +265,42 @@ class TornDisk:
             self.torn += 1
             Path(path).write_bytes(data[: max(1, len(data) // 2)])
             raise OSError("injected torn write")
+        with open(path, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+
+    def replace(self, src: Path, dst: Path) -> None:
+        os.replace(src, dst)
+
+    def read_bytes(self, path: Path) -> bytes:
+        return Path(path).read_bytes()
+
+
+class ManifestCrashDisk:
+    """CheckpointIO-compatible disk that dies exactly at the manifest
+    write once :meth:`arm`\\ ed — the async sharded writer's commit point
+    (train/datastream.AsyncShardedCheckpointer writes every shard file,
+    THEN the manifest).  Shard files written before the crash land
+    normally, so the fault leaves realistic litter on disk; the manifest
+    never lands, so ``restore_latest`` must fall back to the previous
+    checkpoint untouched.  Deterministic by construction — no RNG, the
+    crash fires on the first armed manifest write."""
+
+    def __init__(self, marker: str = "manifest"):
+        self.marker = marker
+        self.armed = False
+        self.crashes = 0
+        self.writes = 0
+
+    def arm(self) -> None:
+        self.armed = True
+
+    def write_bytes(self, path: Path, data: bytes) -> None:
+        self.writes += 1
+        if self.armed and self.marker in Path(path).name:
+            self.crashes += 1
+            raise OSError("injected writer crash at the manifest commit point")
         with open(path, "wb") as fh:
             fh.write(data)
             fh.flush()

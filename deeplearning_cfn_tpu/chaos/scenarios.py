@@ -39,6 +39,14 @@ Catalog:
   restarts, no lost steps, preserved global batch (grad-accum rescale)
   and loss continuity against an uninterrupted run; the forced-fallback
   variant must degrade to the checkpoint/restore path and still line up.
+* ``data-reshard-live`` — the data plane's turn: four hosts stream real
+  DLC1 record shards, a slice dies mid-epoch, and the live reshard must
+  hand the unfinished work to the survivors with every record consumed
+  exactly once and byte-deterministic order per seed; a run stopped and
+  resumed from the async sharded checkpointer's v3 envelope (state +
+  stream cursor) must reproduce the unbroken run's loss sequence
+  bit-identically, and a writer crashed at the manifest commit point
+  must leave the previous checkpoint fully restorable.
 """
 
 from __future__ import annotations
@@ -888,6 +896,374 @@ def slice_loss_live(seed: int) -> ScenarioReport:
         fallback_losses=[round(v, 6) for v in fallback_losses],
         fallback_restore_step=restore_step,
     )
+    return report
+
+
+# --- data-reshard-live -------------------------------------------------------
+
+
+def _datastream_event_count(event: str) -> int:
+    from deeplearning_cfn_tpu.obs.recorder import get_recorder
+
+    return sum(
+        1
+        for e in get_recorder().tail(8192)
+        if e.get("kind") == "datastream" and e.get("event") == event
+    )
+
+
+def data_reshard_live(seed: int) -> ScenarioReport:
+    """The data plane survives a mid-epoch slice loss exactly-once, and a
+    run resumed from a v3 envelope reproduces the unbroken loss sequence
+    bit-identically.
+
+    Phase 1 drives :class:`~deeplearning_cfn_tpu.train.datastream.
+    DataStreamPlane` over REAL DLC1 shard files: four hosts (two slices)
+    interleave batches, slice s1 dies mid-epoch, and
+    ``plane.reshard(contract.surviving(["s1"]))`` redistributes the
+    epoch's unfinished work over the survivors.  Invariants: every
+    record is consumed exactly once (zero dropped, zero duplicated —
+    asserted on record ids baked into the shards), the per-host shard
+    assignment is an exact partition, and the whole consumption order is
+    byte-deterministic per seed (the run replays identically).
+
+    Phase 2 trains a real FSDP model (8 virtual CPU devices) from the
+    record stream with :class:`~deeplearning_cfn_tpu.train.datastream.
+    AsyncShardedCheckpointer` capturing the stream cursor in the v3
+    envelope every step (``prefetch=0``, the bit-exact-resume mode).
+    A run stopped at step K and restored — state from the sharded JSON
+    codec, stream from ``last_stream_state`` — must reproduce the
+    uninterrupted run's loss sequence EXACTLY, float for float.  A
+    writer crashed at the manifest commit point (ManifestCrashDisk)
+    must leave shard litter but no manifest, the previous checkpoint
+    fully restorable, and the recorded v3 topology must gate a
+    cross-topology restore.
+    """
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import jax
+    import numpy as np
+    import flax.linen as nn
+
+    from deeplearning_cfn_tpu.chaos.injectors import ManifestCrashDisk
+    from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+    from deeplearning_cfn_tpu.parallel.mesh import (
+        MeshSpec,
+        hybrid_mesh_for_slices,
+        virtual_cpu_devices,
+    )
+    from deeplearning_cfn_tpu.train.checkpoint import TopologyMismatch
+    from deeplearning_cfn_tpu.train.data import SyntheticDataset
+    from deeplearning_cfn_tpu.train.datastream import (
+        AsyncShardedCheckpointer,
+        DataStreamPlane,
+        HostShardStream,
+        assign_shards,
+    )
+    from deeplearning_cfn_tpu.train.records import (
+        Field,
+        RecordSpec,
+        write_dataset,
+        write_records,
+    )
+    from deeplearning_cfn_tpu.train.reshard import mesh_topology
+    from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
+
+    report = ScenarioReport("data-reshard-live", seed)
+    devices = virtual_cpu_devices(8)
+    root = Path(tempfile.mkdtemp(prefix="dlcfn-chaos-data-"))
+    try:
+        # --- phase 1: exactly-once over a live reshard -------------------
+        # Records carry their global id in ``y``, so "every record exactly
+        # once" is literally ``sorted(seen) == range(total)``.
+        spec = RecordSpec((Field("x", "uint8", (2,)), Field("y", "int32", ())))
+        sizes = [17 + (3 * sid + seed) % 7 for sid in range(6)]  # uneven
+        paths: list[Path] = []
+        gid = 0
+        for sid, n in enumerate(sizes):
+            recs = []
+            for _ in range(n):
+                recs.append(
+                    spec.encode(
+                        x=np.array([gid % 251, gid % 7], dtype=np.uint8),
+                        y=np.int32(gid),
+                    )
+                )
+                gid += 1
+            p = root / f"shard-{sid:02d}.dlc"
+            write_records(p, spec, recs)
+            paths.append(p)
+        total = gid
+
+        def make_contract() -> ClusterContract:
+            return ClusterContract.build(
+                cluster_name="chaos-data",
+                coordinator_ip="10.0.0.1",
+                other_worker_ips=["10.0.0.2", "10.0.0.3", "10.0.0.4"],
+                chips_per_worker=2,
+                storage_mount="/mnt/none",
+                slices={
+                    "s0": ["10.0.0.1", "10.0.0.2"],
+                    "s1": ["10.0.0.3", "10.0.0.4"],
+                },
+            )
+
+        def run_plane() -> tuple[dict[str, list[int]], dict]:
+            contract = make_contract()
+            plane = DataStreamPlane(
+                contract, paths, spec, batch_size=5, seed=seed, loop=False
+            )
+            ids: dict[str, list[int]] = {h: [] for h in plane.hosts}
+            iters = {h: plane.stream(h).batches() for h in plane.hosts}
+            # Two interleaved rounds across all four hosts, then s1 dies
+            # mid-epoch with partially-read shards on both sides.
+            for _ in range(2):
+                for h in list(plane.hosts):
+                    b = next(iters[h], None)
+                    if b is not None:
+                        ids[h].extend(int(v) for v in b.y)
+            plane.reshard(contract.surviving(["s1"]))
+            for h in tuple(plane.hosts):  # survivors drain the epoch
+                for b in iters[h]:
+                    ids[h].extend(int(v) for v in b.y)
+            snap = plane.journal_progress()
+            return ids, snap
+
+        hosts4 = make_contract().datastream_hosts()
+        assigned = assign_shards(hosts4, len(paths), seed, 0)
+        report.check(
+            sorted(s for w in assigned.values() for s in w)
+            == list(range(len(paths))),
+            "per-host shard assignment is an exact partition of the "
+            "shard set (every shard owned by exactly one host)",
+        )
+        reshard_before = _datastream_event_count("reshard")
+        ids1, snap1 = run_plane()
+        ids2, _snap2 = run_plane()
+        seen = sorted(v for host_ids in ids1.values() for v in host_ids)
+        report.check(
+            seen == list(range(total)),
+            "every record consumed exactly once across the live reshard "
+            "(zero dropped, zero duplicated, including the lost hosts' "
+            "pre-loss reads)",
+        )
+        report.check(
+            ids1 == ids2,
+            "consumption order is byte-deterministic per seed: the full "
+            "run (including the reshard splice) replays identically",
+        )
+        report.check(
+            _datastream_event_count("reshard") - reshard_before == 2,
+            "each reshard journaled exactly one datastream reshard event",
+        )
+        report.check(
+            snap1["records_total"] == total
+            and snap1["hosts"] == 2
+            and snap1["reshards"] == 1,
+            "plane snapshot agrees with ground truth: all records "
+            "counted, two survivors, one reshard",
+        )
+
+        # --- phase 2: bit-identical resume from the v3 envelope ----------
+        class _Net(nn.Module):
+            # fc2's 256x256 kernel clears the FSDP heuristic's
+            # min_shard_elems, so the codec round-trips sharded arrays.
+            @nn.compact
+            def __call__(self, x):
+                x = x.reshape((x.shape[0], -1))
+                x = nn.relu(nn.Dense(256, name="fc1")(x))
+                x = nn.relu(nn.Dense(256, name="fc2")(x))
+                return nn.Dense(10, name="head")(x)
+
+        mesh = hybrid_mesh_for_slices(
+            2,
+            ici_spec=MeshSpec.fsdp_parallel(4),
+            dcn_axis="dp",
+            devices=devices[:8],
+        )
+
+        def make_config() -> TrainerConfig:
+            return TrainerConfig(
+                optimizer="adamw",
+                learning_rate=1e-3,
+                strategy="fsdp",
+                matmul_precision="float32",
+                log_every=1,
+                grad_accum_steps=1,
+            )
+
+        # 2 shards x 128 records = 256 = exactly 8 batches of 32: the
+        # stop/resume seam lands mid-epoch, the run ends on the boundary.
+        spec2 = RecordSpec.classification((8, 8, 1), "float32")
+        tpaths: list[Path] = []
+        for i in range(2):
+            ds = SyntheticDataset(
+                shape=(8, 8, 1), num_classes=10, batch_size=32, seed=seed * 7 + i
+            )
+            p = root / f"train-{i}.dlc"
+            write_dataset(p, spec2, ds.batches(4), 4)
+            tpaths.append(p)
+
+        def train_stream(state=None) -> HostShardStream:
+            return HostShardStream(
+                tpaths,
+                spec2,
+                32,
+                host="10.0.0.1",
+                hosts=("10.0.0.1",),
+                seed=seed,
+                loop=True,
+                state=state,
+            )
+
+        total_steps = 8
+        stop = 3 + seed % 3
+        sample = next(train_stream().batches(1)).x
+
+        trainer_a = Trainer(_Net(), mesh, make_config())
+        state_a = trainer_a.init(jax.random.PRNGKey(seed), sample)
+        _, straight = trainer_a.fit(
+            state_a, train_stream().batches(), steps=total_steps, prefetch=0
+        )
+
+        writes_before = _datastream_event_count("checkpoint_write")
+        trainer_b = Trainer(_Net(), mesh, make_config())
+        state_b = trainer_b.init(jax.random.PRNGKey(seed), sample)
+        stream_b = train_stream()
+        ck = AsyncShardedCheckpointer(
+            root / "ackpt", every_steps=1, n_shards=3
+        )
+        state_b, losses1 = trainer_b.fit(
+            state_b,
+            stream_b.batches(),
+            steps=stop,
+            prefetch=0,
+            checkpointer=ck,
+            datastream=stream_b,
+        )
+        ck.wait()
+        report.check(
+            losses1 == straight[:stop],
+            "pre-stop losses bit-identical to the uninterrupted run "
+            "(same records, same arithmetic)",
+        )
+        report.check(
+            ck.latest_step() == stop
+            and _datastream_event_count("checkpoint_write") - writes_before >= 1,
+            "the background writer committed the stop-step manifest "
+            "(journaled checkpoint_write) without ever blocking a step",
+        )
+        trainer_c = Trainer(_Net(), mesh, make_config())
+        template = trainer_c.init(jax.random.PRNGKey(seed), sample)
+        restored = ck.restore_latest(template=template)
+        report.check(restored is not None, "v3 manifest restored")
+        assert restored is not None
+        state_c, rstep = restored
+        report.check(
+            rstep == stop
+            and ck.last_stream_state is not None
+            and ck.last_stream_state["host"] == "10.0.0.1",
+            "restore returned the stop step and the envelope's stream "
+            "state for the right host",
+        )
+        stream_c = train_stream(state=ck.last_stream_state)
+        report.check(
+            stream_c.records_total == stop * 32,
+            "resumed stream cursor sits exactly stop*batch records in — "
+            "no replay, no skip",
+        )
+        _, losses2 = trainer_c.fit(
+            state_c,
+            stream_c.batches(),
+            steps=total_steps - stop,
+            prefetch=0,
+        )
+        report.check(
+            losses1 + losses2 == straight,
+            "resumed run reproduces the unbroken run's loss sequence "
+            "bit-identically (exact float equality, the v3 acceptance "
+            "bar: JSON codec + stream cursor both lossless)",
+        )
+        ck.close()
+
+        # --- phase 2b: writer crash at the manifest commit point ---------
+        disk = ManifestCrashDisk()
+        failed_before = _datastream_event_count("checkpoint_write_failed")
+        topo = mesh_topology(mesh)
+        payload = {
+            "w": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b": np.float32(0.5),
+        }
+        ck2 = AsyncShardedCheckpointer(
+            root / "crash", every_steps=1, n_shards=2, io=disk
+        )
+        ck2.save(
+            1,
+            payload,
+            mesh_topology=topo,
+            stream_state={"host": "10.0.0.1", "cursor": 1},
+        )
+        ck2.wait()
+        disk.arm()
+        ck2.save(2, {"w": payload["w"] + 1.0, "b": np.float32(1.5)})
+        ck2.wait()
+        report.check(
+            ck2.write_failures == 1
+            and disk.crashes == 1
+            and _datastream_event_count("checkpoint_write_failed")
+            - failed_before
+            == 1,
+            "the armed crash fired exactly once at the manifest write and "
+            "was journaled as checkpoint_write_failed (writer survived)",
+        )
+        report.check(
+            not (root / "crash" / "ckpt-00000002.manifest.json").exists()
+            and (
+                root / "crash" / "ckpt-00000002.shard-00-of-02.json"
+            ).exists(),
+            "the crashed step left shard litter but NO manifest: the "
+            "commit point never passed",
+        )
+        template2 = {"w": np.zeros((3, 4), np.float32), "b": np.float32(0.0)}
+        r2 = ck2.restore_latest(template=template2, expected_topology=topo)
+        report.check(
+            r2 is not None
+            and r2[1] == 1
+            and np.array_equal(r2[0]["w"], payload["w"])
+            and ck2.last_stream_state == {"host": "10.0.0.1", "cursor": 1},
+            "the previous checkpoint (state, step, stream state) is "
+            "fully restorable after the crash — bit-equal leaves",
+        )
+        mismatch = False
+        try:
+            ck2.restore_latest(
+                template=template2,
+                expected_topology={"devices": 4, "axes": {"fsdp": 4}},
+            )
+        except TopologyMismatch:
+            mismatch = True
+        report.check(
+            mismatch,
+            "the recorded v3 mesh topology gates cross-topology restores "
+            "(TopologyMismatch, fail-fast)",
+        )
+        ck2.close()
+
+        report.details.update(
+            stop_step=stop,
+            total_records=total,
+            shard_sizes=sizes,
+            straight_losses=[round(v, 6) for v in straight],
+            resumed_losses=[round(v, 6) for v in losses1 + losses2],
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
     return report
 
 
@@ -1790,6 +2166,7 @@ SCENARIOS: dict[str, Callable[[int], ScenarioReport]] = {
     "flaky-rpc": flaky_rpc,
     "slow-disk": slow_disk,
     "slice-loss-live": slice_loss_live,
+    "data-reshard-live": data_reshard_live,
     "straggler": straggler,
     "serve-replica-loss": serve_replica_loss,
     "broker-failover": broker_failover,

@@ -758,6 +758,20 @@ def _status_comms(args) -> dict | None:
     return dict(sorted(folded.items())) or None
 
 
+def _status_datastream(args) -> dict | None:
+    """Data-plane counters (records/sec, shard lag, reshards, async
+    checkpoint write seconds, native-loader fallbacks) folded from
+    journaled ``datastream`` events, or None (no journal / no data
+    plane).  Feeds the ``dlcfn_datastream_*`` gauges in the Prometheus
+    rendering."""
+    if not args.journal:
+        return None
+    from deeplearning_cfn_tpu.obs.exporter import fold_datastream_events
+    from deeplearning_cfn_tpu.obs.recorder import read_journal
+
+    return fold_datastream_events(read_journal(args.journal, kind="datastream")) or None
+
+
 def _status_fleet(args, liveness) -> dict | None:
     """Fleet-merged agent telemetry from the broker's TELEM table, or
     None (``--fleet`` not passed / no broker source / dial failure).
@@ -891,6 +905,7 @@ def cmd_status(args) -> int:
     profile = _status_profile(args)
     serve = _status_serve(args)
     comms = _status_comms(args)
+    datastream = _status_datastream(args)
     fleet = _status_fleet(args, liveness)
     workers = _status_metrics(args.metrics_dir) if args.metrics_dir else None
     if args.metrics_dir and workers is None:
@@ -912,6 +927,7 @@ def cmd_status(args) -> int:
                 broker=broker,
                 comms=comms,
                 fleet=fleet,
+                datastream=datastream,
             ),
             end="",
         )
@@ -926,6 +942,7 @@ def cmd_status(args) -> int:
         and profile is None
         and serve is None
         and comms is None
+        and datastream is None
         and fleet is None
     ):
         # Metrics-only: the original (round-4) output shape, unchanged.
@@ -950,6 +967,8 @@ def cmd_status(args) -> int:
         out["serve"] = serve
     if comms is not None:
         out["comms"] = comms
+    if datastream is not None:
+        out["datastream"] = datastream
     if fleet is not None:
         out["fleet"] = fleet
     if workers is not None:

@@ -237,6 +237,15 @@ class ClusterContract:
     def hosts_entries(self) -> list[tuple[str, str]]:
         return list(zip(self.worker_ips, self.hostnames()))
 
+    def datastream_hosts(self) -> tuple[str, ...]:
+        """The data plane's host ordering (train/datastream): shard
+        assignment is positional over this tuple, so it must be the
+        contract's canonical worker order — coordinator's slice first,
+        slices contiguous (``build()`` normalizes exactly that).  A
+        ``surviving()`` contract preserves relative order, which is what
+        keeps reassignment deterministic across a live reshard."""
+        return tuple(self.worker_ips)
+
     def env(self, root: Path | None = None) -> dict[str, str]:
         """The DEEPLEARNING_* contract (dl_cfn_setup_v2.py:104-109), chips
         instead of GPUs, plus the jax.distributed coordination triple.

@@ -64,6 +64,14 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
     "dlcfn_broker_up": ("gauge", "1 while the node answers on loopback."),
     "dlcfn_broker_replication_lag_seconds": ("gauge", "Age of the oldest journal entry the standby has not applied."),
     "dlcfn_broker_replication_lag_entries": ("gauge", "Journal entries the standby has not applied."),
+    # sharded streaming data plane (train/datastream, docs/DATA.md)
+    "dlcfn_datastream_records_per_s": ("gauge", "Records/second the data plane delivered (plane lifetime)."),
+    "dlcfn_datastream_records_total": ("counter", "Records the data plane delivered."),
+    "dlcfn_datastream_shard_lag": ("gauge", "Spread (max-min) of records remaining across hosts — shard imbalance."),
+    "dlcfn_datastream_reshard_total": ("counter", "Data-plane reshards (epoch work redistributed over survivors)."),
+    "dlcfn_datastream_checkpoint_write_seconds": ("gauge", "Off-path seconds the background writer spent on the last sharded checkpoint."),
+    "dlcfn_datastream_checkpoint_writes_total": ("counter", "Async sharded checkpoint manifests committed."),
+    "dlcfn_datastream_native_fallback_total": ("counter", "Record-loader falls from native to the pure-Python reader."),
     # fleet telemetry (TELEM plane, obs/aggregator.py)
     "dlcfn_fleet_workers": ("gauge", "Workers with a fresh telemetry snapshot in the fleet merge."),
     "dlcfn_fleet_telemetry_age_seconds": ("gauge", "Age of each worker's newest telemetry snapshot."),
@@ -173,6 +181,79 @@ def fold_comms_events(events) -> dict[str, Any]:
     return out
 
 
+def fold_datastream_events(events) -> dict[str, Any]:
+    """Fold flight-journal ``datastream`` events (data-plane progress,
+    reshards, async-checkpoint writes, loader fallbacks) into the
+    counters ``dlcfn status`` and the ``dlcfn_datastream_*`` gauges
+    surface.  Progress events are full snapshots, so last-wins; the
+    rest count.  Empty dict when the data plane never journaled."""
+    out: dict[str, Any] = {
+        "progress": None,
+        "hosts": {},
+        "reshard_total": 0,
+        "last_reshard": None,
+        "checkpoint": {
+            "writes": 0,
+            "failures": 0,
+            "superseded": 0,
+            "seconds_total": 0.0,
+            "last_write_seconds": None,
+            "last_step": None,
+        },
+        "native_fallback_total": 0,
+    }
+    saw = False
+    for event in events:
+        if event.get("kind") != "datastream":
+            continue
+        saw = True
+        name = event.get("event")
+        if name == "progress":
+            out["progress"] = {
+                k: event.get(k)
+                for k in (
+                    "hosts",
+                    "shards",
+                    "records_total",
+                    "records_per_s",
+                    "shard_lag",
+                    "reshards",
+                    "epoch",
+                )
+            }
+        elif name == "host_progress":
+            out["hosts"][str(event.get("host") or "?")] = {
+                k: event.get(k) for k in ("records", "remaining", "epoch")
+            }
+        elif name == "reshard":
+            out["reshard_total"] += 1
+            out["last_reshard"] = {
+                k: event.get(k)
+                for k in (
+                    "epoch",
+                    "lost_hosts",
+                    "survivors",
+                    "work_units",
+                    "records_remaining",
+                )
+            }
+        elif name == "checkpoint_write":
+            ck = out["checkpoint"]
+            ck["writes"] += 1
+            ck["seconds_total"] = round(
+                ck["seconds_total"] + float(event.get("seconds") or 0.0), 6
+            )
+            ck["last_write_seconds"] = event.get("seconds")
+            ck["last_step"] = event.get("step")
+        elif name == "checkpoint_write_failed":
+            out["checkpoint"]["failures"] += 1
+        elif name == "checkpoint_superseded":
+            out["checkpoint"]["superseded"] += 1
+        elif name == "native_fallback":
+            out["native_fallback_total"] += 1
+    return out if saw else {}
+
+
 def render_prometheus(
     liveness: Mapping[str, Mapping[str, Any]] | None = None,
     spans: Mapping[str, Mapping[str, Any]] | None = None,
@@ -185,6 +266,7 @@ def render_prometheus(
     broker: Mapping[str, Any] | None = None,
     comms: Mapping[str, Mapping[str, Any]] | None = None,
     fleet: Mapping[str, Any] | None = None,
+    datastream: Mapping[str, Any] | None = None,
 ) -> str:
     """Render liveness snapshot + span aggregates + input-pipeline
     counters as Prometheus text.
@@ -203,7 +285,9 @@ def render_prometheus(
     plus replication lag); ``comms`` is ``fold_comms_events()`` (the
     comms-audit sentinel's per-program collective/HBM budgets);
     ``fleet`` is ``obs.aggregator.FleetAggregator.merge()`` (the TELEM
-    fleet merge).  Any may be None/empty.
+    fleet merge); ``datastream`` is ``fold_datastream_events()`` (the
+    sharded streaming data plane's progress/reshard/async-checkpoint
+    counters).  Any may be None/empty.
     """
     lines: list[str] = []
     seen: set[str] = set()
@@ -472,5 +556,41 @@ def render_prometheus(
             head("dlcfn_worker_dead_fraction")
             lines.append(
                 f"dlcfn_worker_dead_fraction{_labels(cluster=cluster)} {dead_fraction}"
+            )
+    if datastream:
+        progress = datastream.get("progress") or {}
+        for name, key in (
+            ("dlcfn_datastream_records_per_s", "records_per_s"),
+            ("dlcfn_datastream_records_total", "records_total"),
+            ("dlcfn_datastream_shard_lag", "shard_lag"),
+        ):
+            value = progress.get(key)
+            if value is None:
+                continue
+            head(name)
+            lines.append(f"{name}{_labels(cluster=cluster)} {value}")
+        head("dlcfn_datastream_reshard_total")
+        lines.append(
+            f"dlcfn_datastream_reshard_total{_labels(cluster=cluster)}"
+            f" {datastream.get('reshard_total', 0)}"
+        )
+        checkpoint = datastream.get("checkpoint") or {}
+        if checkpoint.get("last_write_seconds") is not None:
+            head("dlcfn_datastream_checkpoint_write_seconds")
+            lines.append(
+                f"dlcfn_datastream_checkpoint_write_seconds"
+                f"{_labels(cluster=cluster)} {checkpoint['last_write_seconds']}"
+            )
+        if checkpoint.get("writes"):
+            head("dlcfn_datastream_checkpoint_writes_total")
+            lines.append(
+                f"dlcfn_datastream_checkpoint_writes_total"
+                f"{_labels(cluster=cluster)} {checkpoint['writes']}"
+            )
+        if datastream.get("native_fallback_total"):
+            head("dlcfn_datastream_native_fallback_total")
+            lines.append(
+                f"dlcfn_datastream_native_fallback_total"
+                f"{_labels(cluster=cluster)} {datastream['native_fallback_total']}"
             )
     return "\n".join(lines) + ("\n" if lines else "")

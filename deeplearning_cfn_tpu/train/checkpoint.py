@@ -185,14 +185,21 @@ class TopologyMismatch(ValueError):
         )
 
 
-# Envelope version 2 adds the optional ``mesh_topology`` field.  The sha256
-# covers the STATE body only, so v1 readers ignore the extra keys and v2
-# readers treat a v1 envelope (no topology) as unconstrained — both
-# directions stay compatible.
-ENVELOPE_VERSION = 2
+# Envelope version 2 added the optional ``mesh_topology`` field; version 3
+# adds the optional ``stream_state`` field (the data plane's resumable
+# iterator position, train/datastream).  The sha256 covers the STATE body
+# only, so every direction stays compatible: v1/v2 readers ignore the extra
+# keys, and a v3 reader treats a v1/v2 envelope as having no topology
+# constraint and no stream state.
+ENVELOPE_VERSION = 3
 
 
-def _envelope(step: int, state: dict, mesh_topology: dict | None = None) -> bytes:
+def _envelope(
+    step: int,
+    state: dict,
+    mesh_topology: dict | None = None,
+    stream_state: dict | None = None,
+) -> bytes:
     from deeplearning_cfn_tpu.train.metrics import json_safe
 
     body = json.dumps(json_safe(state), sort_keys=True, allow_nan=False)
@@ -204,19 +211,29 @@ def _envelope(step: int, state: dict, mesh_topology: dict | None = None) -> byte
     if mesh_topology is not None:
         env["version"] = ENVELOPE_VERSION
         env["mesh_topology"] = json_safe(mesh_topology)
+    if stream_state is not None:
+        env["version"] = ENVELOPE_VERSION
+        env["stream_state"] = json_safe(stream_state)
     return json.dumps(env, allow_nan=False).encode()
 
 
-def _open_envelope(raw: bytes) -> tuple[dict, int, dict | None] | None:
+def _open_envelope(raw: bytes) -> tuple[dict, int, dict | None, dict | None] | None:
     """Parse + verify an envelope; None for torn/corrupt bytes.  The third
-    element is the recorded mesh topology (None for v1 envelopes)."""
+    element is the recorded mesh topology (None for v1 envelopes), the
+    fourth the recorded stream state (None below v3)."""
     try:
         env = json.loads(raw.decode())
         body = json.dumps(env["state"], sort_keys=True, allow_nan=False)
         if hashlib.sha256(body.encode()).hexdigest() != env["sha256"]:
             return None
         topology = env.get("mesh_topology")
-        return env["state"], int(env["step"]), topology if isinstance(topology, dict) else None
+        stream_state = env.get("stream_state")
+        return (
+            env["state"],
+            int(env["step"]),
+            topology if isinstance(topology, dict) else None,
+            stream_state if isinstance(stream_state, dict) else None,
+        )
     except (ValueError, KeyError, TypeError, UnicodeDecodeError):
         return None
 
@@ -247,10 +264,16 @@ class StateCheckpointer:
     directory: str | Path
     max_to_keep: int = 3
     io: CheckpointIO = field(default_factory=CheckpointIO)
+    #: duck-typing marker Trainer.fit keys on before passing
+    #: ``stream_state=`` (orbax and custom tiers may not accept it)
+    accepts_stream_state = True
 
     def __post_init__(self) -> None:
         self._dir = Path(self.directory).absolute()
         self._dir.mkdir(parents=True, exist_ok=True)
+        #: the stream state of the last envelope ``restore_latest``
+        #: returned (None when absent — v1/v2 envelopes, fresh runs)
+        self.last_stream_state: dict | None = None
 
     def _file(self, step: int) -> Path:
         return self._dir / f"state-{step:08d}.json"
@@ -269,12 +292,18 @@ class StateCheckpointer:
         return steps[-1] if steps else None
 
     def save(
-        self, step: int, state: dict, mesh_topology: dict | None = None
+        self,
+        step: int,
+        state: dict,
+        mesh_topology: dict | None = None,
+        stream_state: dict | None = None,
     ) -> Path:
         final = self._file(step)
         tmp = self._dir / f".{final.name}.tmp-{os.getpid()}"
         try:
-            self.io.write_bytes(tmp, _envelope(step, state, mesh_topology))
+            self.io.write_bytes(
+                tmp, _envelope(step, state, mesh_topology, stream_state)
+            )
             self.io.replace(tmp, final)
         finally:
             # A torn write must not litter: the temp either renamed away
@@ -299,8 +328,9 @@ class StateCheckpointer:
                 continue
             opened = _open_envelope(raw)
             if opened is not None:
-                state, found_step, topology = opened
+                state, found_step, topology, stream_state = opened
                 _check_topology(expected_topology, topology, found_step)
+                self.last_stream_state = stream_state
                 return state, found_step
             log.warning(
                 "checkpoint step %d failed verification; skipping", step
@@ -321,6 +351,10 @@ class ObjectStoreCheckpointer:
 
     store: Any  # ObjectStore protocol: put/get/list
     prefix: str = "checkpoints"
+    accepts_stream_state = True
+
+    def __post_init__(self) -> None:
+        self.last_stream_state: dict | None = None
 
     def _key(self, step: int) -> str:
         return f"{self.prefix}/state-{step:08d}.json"
@@ -341,10 +375,14 @@ class ObjectStoreCheckpointer:
         return steps[-1] if steps else None
 
     def save(
-        self, step: int, state: dict, mesh_topology: dict | None = None
+        self,
+        step: int,
+        state: dict,
+        mesh_topology: dict | None = None,
+        stream_state: dict | None = None,
     ) -> str:
         key = self._key(step)
-        self.store.put(key, _envelope(step, state, mesh_topology))
+        self.store.put(key, _envelope(step, state, mesh_topology, stream_state))
         return key
 
     def restore_latest(
@@ -357,8 +395,9 @@ class ObjectStoreCheckpointer:
                 continue
             opened = _open_envelope(bytes(raw))
             if opened is not None:
-                state, found_step, topology = opened
+                state, found_step, topology, stream_state = opened
                 _check_topology(expected_topology, topology, found_step)
+                self.last_stream_state = stream_state
                 return state, found_step
         return None
 
@@ -375,10 +414,12 @@ class FallbackCheckpointer:
     failure_threshold: int = 3
     reset_after_s: float = 60.0
     clock: Clock = field(default_factory=MonotonicClock)
+    accepts_stream_state = True
 
     def __post_init__(self) -> None:
         if not self.tiers:
             raise ValueError("FallbackCheckpointer needs at least one tier")
+        self.last_stream_state: dict | None = None
         self._breakers = {
             name: CircuitBreaker(
                 name=f"checkpoint.{name}",
@@ -398,7 +439,11 @@ class FallbackCheckpointer:
         return self._breakers[name]
 
     def save(
-        self, step: int, state: dict, mesh_topology: dict | None = None
+        self,
+        step: int,
+        state: dict,
+        mesh_topology: dict | None = None,
+        stream_state: dict | None = None,
     ) -> str:
         """Write to the first healthy tier; returns the tier name used."""
         last_err: BaseException | None = None
@@ -407,12 +452,16 @@ class FallbackCheckpointer:
             if not breaker.allow():
                 continue
             try:
-                # Custom tiers predating envelope v2 may not accept the
-                # kwarg; only pass it when there is a topology to record.
+                # Custom tiers predating envelope v2/v3 may not accept
+                # the kwargs; only pass what there is to record.
+                kwargs: dict = {}
                 if mesh_topology is not None:
-                    tier.save(step, state, mesh_topology=mesh_topology)
-                else:
-                    tier.save(step, state)
+                    kwargs["mesh_topology"] = mesh_topology
+                if stream_state is not None and getattr(
+                    tier, "accepts_stream_state", False
+                ):
+                    kwargs["stream_state"] = stream_state
+                tier.save(step, state, **kwargs)
             except Exception as exc:
                 breaker.record_failure()
                 last_err = exc
@@ -433,6 +482,7 @@ class FallbackCheckpointer:
         """Newest verifiable checkpoint across all tiers (a degraded run
         may have its freshest state on the fallback tier)."""
         best: tuple[dict, int] | None = None
+        best_tier: Any = None
         for name, tier in self.tiers:
             try:
                 found = tier.restore_latest()
@@ -441,6 +491,9 @@ class FallbackCheckpointer:
                 continue
             if found is not None and (best is None or found[1] > best[1]):
                 best = found
+                best_tier = tier
+        if best is not None:
+            self.last_stream_state = getattr(best_tier, "last_stream_state", None)
         return best
 
     def _record_fallback(self, tier: str, step: int) -> None:

@@ -95,8 +95,14 @@ class PipelineStats:
     finally and an explicit close without double-journaling).
     """
 
-    def __init__(self, name: str = "input"):
+    def __init__(self, name: str = "input", source: str = "synthetic"):
         self.name = name
+        # What fed the pipeline: "synthetic" (in-memory generated
+        # batches) or "records" (the train/datastream DLC1 shard path).
+        # Journaled so a throughput number in `dlcfn status` is never
+        # compared across input modes by accident (bench_compare.py
+        # makes the same refusal across bench rounds).
+        self.source = source
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
         self.batches = 0
@@ -132,6 +138,7 @@ class PipelineStats:
             overlap = 1.0 - min(self.consumer_wait_seconds / elapsed, 1.0)
             return {
                 "name": self.name,
+                "source": self.source,
                 "batches": self.batches,
                 "bytes_transferred": self.bytes_transferred,
                 "host_input_seconds": round(self.host_input_seconds, 6),
@@ -169,6 +176,7 @@ def fold_pipeline_events(events) -> dict[str, dict[str, Any]]:
         agg = out.setdefault(
             name,
             {
+                "source": None,
                 "runs": 0,
                 "batches": 0,
                 "bytes_transferred": 0,
@@ -179,6 +187,8 @@ def fold_pipeline_events(events) -> dict[str, dict[str, Any]]:
             },
         )
         agg["runs"] += 1
+        if isinstance(event.get("source"), str):
+            agg["source"] = event["source"]
         for key in (
             "batches",
             "bytes_transferred",

@@ -27,6 +27,7 @@ from typing import Iterator, Sequence
 import numpy as np
 
 from deeplearning_cfn_tpu.train.data import Batch
+from deeplearning_cfn_tpu.utils.atomicio import atomic_writer
 
 MAGIC = b"DLC1"
 HEADER = struct.Struct("<4sIQ")  # magic, record_size, n_records
@@ -109,11 +110,19 @@ class RecordSpec:
 
 
 def write_records(path: str | Path, spec: RecordSpec, records: Iterator[bytes] | list[bytes]) -> int:
-    """Write a DLC1 file; returns the record count."""
+    """Write a DLC1 file; returns the record count.
+
+    Atomic (utils/atomicio): the records stream into a dot-prefixed temp
+    file — including the header count patched in by seek once the stream
+    ends — and only a clean finish renames it into place.  A writer torn
+    mid-stream (crash, raising generator) leaves NOTHING at ``path``, so
+    ``read_header`` can never accept a half-written shard whose header
+    already looked valid.
+    """
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     n = 0
-    with open(path, "wb") as f:
+    with atomic_writer(path) as f:
         f.write(HEADER.pack(MAGIC, spec.record_size, 0))  # patched below
         for rec in records:
             if len(rec) != spec.record_size:

@@ -201,6 +201,12 @@ class LiveReshardCoordinator:
     records: list[ReshardRecord] = field(default_factory=list)
     fallback_pending: bool = False
     fallback_contract: Any = None
+    #: called with the surviving contract right after every commit (live
+    #: AND fallback) — the data plane's reshard seam: wire
+    #: ``on_commit=plane.reshard`` and the record stream is re-partitioned
+    #: over the survivors at the same step boundary the mesh is
+    #: (train/datastream.DataStreamPlane, docs/DATA.md).
+    on_commit: Callable[[Any], Any] | None = None
 
     @property
     def live_total(self) -> int:
@@ -239,6 +245,8 @@ class LiveReshardCoordinator:
             trainer.config.grad_accum_steps = new_accum
             trainer.rebind_mesh(new_mesh, shardings)
             self.manager.commit(contract)
+            if self.on_commit is not None:
+                self.on_commit(contract)
             record = ReshardRecord(
                 step=step,
                 mode="live",
@@ -283,6 +291,8 @@ class LiveReshardCoordinator:
             self.fallback_pending = True
             self.fallback_contract = contract
             self.manager.commit(contract)
+            if self.on_commit is not None:
+                self.on_commit(contract)
             record = ReshardRecord(
                 step=step,
                 mode="fallback",

@@ -743,6 +743,25 @@ class Trainer:
         out["examples"] = examples
         return out
 
+    def _save_checkpoint(
+        self, checkpointer: Any, step: int, state: TrainState, datastream: Any
+    ) -> None:
+        """One checkpoint save, with the data plane's position attached
+        when both sides support it.  With ``prefetch > 0`` the stream's
+        host-side cursor can run up to ``prefetch + 1`` batches ahead of
+        the trained step (the buffer was filled ahead); runs that need
+        bit-exact stream resume (chaos ``data-reshard-live``) use
+        ``prefetch=0`` — docs/DATA.md quantifies the skew."""
+        if datastream is not None and getattr(
+            checkpointer, "accepts_stream_state", False
+        ):
+            stream_state = datastream.stream_state()
+            if hasattr(stream_state, "to_json"):
+                stream_state = stream_state.to_json()
+            checkpointer.save(step, state, stream_state=stream_state)
+        else:
+            checkpointer.save(step, state)
+
     # --- convenience loop (the MonitoredTrainingSession analog) ----------
     def fit(
         self,
@@ -757,6 +776,7 @@ class Trainer:
         reshard: Any = None,
         profiler: Any = None,
         steps_per_call: int = 1,
+        datastream: Any = None,
     ) -> tuple[TrainState, list[float]]:
         """``stop_fn(metrics) -> True`` ends training early — the
         time-to-accuracy mode (the reference's only published CIFAR metric
@@ -817,6 +837,17 @@ class Trainer:
         with ``reshard`` (the scan body cannot pause at an inner step
         boundary).  A ``steps % k`` remainder runs via the single-step
         path on the same batch iterator.
+
+        ``datastream`` (a train/datastream.HostShardStream, duck-typed
+        on ``stream_state()``) makes every checkpoint also capture the
+        data plane's position: when the checkpointer advertises
+        ``accepts_stream_state`` (StateCheckpointer,
+        AsyncShardedCheckpointer, FallbackCheckpointer), saves carry the
+        stream state in the v3 envelope so a restored run resumes the
+        record stream exactly where the lost one stopped — docs/DATA.md.
+        ``batches`` should be that same stream's ``batches()`` iterator;
+        the snapshot happens at the step boundary where fit saves, which
+        is a batch boundary of the stream.
         """
         from deeplearning_cfn_tpu.obs.profiler import NULL_PROFILER
         from deeplearning_cfn_tpu.train.data import DevicePrefetcher
@@ -842,6 +873,7 @@ class Trainer:
                 prefetch=prefetch,
                 prefetch_workers=prefetch_workers,
                 profiler=profiler,
+                datastream=datastream,
             )
 
         prof = profiler if profiler is not None else NULL_PROFILER
@@ -919,7 +951,7 @@ class Trainer:
                     logger.step(gstep, metrics["loss"])
                 if checkpointer is not None and checkpointer.should_save(gstep):
                     with span("checkpoint", step=gstep):
-                        checkpointer.save(gstep, state)
+                        self._save_checkpoint(checkpointer, gstep, state, datastream)
                 if gstep % sync_every == 0 or i == steps - 1:
                     # The host blocks here anyway, so drain the pending device
                     # scalars — O(log_every) live buffers instead of O(steps).
@@ -951,6 +983,7 @@ class Trainer:
         prefetch: int = 2,
         prefetch_workers: int = 1,
         profiler: Any = None,
+        datastream: Any = None,
     ) -> tuple[TrainState, list[float]]:
         """The ``steps_per_call=k`` loop: stacked, pre-staged, donated.
 
@@ -1027,7 +1060,7 @@ class Trainer:
                     logger.step(gstep, kloss[-1])
                 if checkpointer is not None and checkpointer.should_save(gstep):
                     with span("checkpoint", step=gstep):
-                        checkpointer.save(gstep, state)
+                        self._save_checkpoint(checkpointer, gstep, state, datastream)
                 if (i + 1) % sync_every == 0 or i == calls - 1:
                     with prof.sync_boundary(len(pending) * k):
                         for vec in jax.device_get(pending):

@@ -5,7 +5,9 @@ across re-anchors) was only visible at re-anchor time because nothing
 diffed consecutive bench rounds.  This prints a one-line verdict per
 tracked metric — MFU, images/sec/chip, and (when a round records them)
 collective bytes and compile/retrace counts — plus an overall line
-check.sh surfaces on every PR.
+check.sh surfaces on every PR.  Rounds fed by different input paths
+(``input_mode``: synthetic vs records) are flagged NOT COMPARABLE
+instead of diffed — the records path does strictly more work per step.
 
 Warn-only BY DESIGN: bench rounds run on whatever chip the round
 happened to land on, so a regression here is a prompt to look, not a
@@ -49,6 +51,19 @@ def mode_regression(old: dict, new: dict) -> str | None:
     if a.startswith("multi_step") and b == "single_step":
         return f"mode regressed ({a} -> {b})"
     return None
+
+
+def input_mode_mismatch(old: dict, new: dict) -> str | None:
+    """Rounds fed by different input paths (synthetic in-memory batches
+    vs the datastream records path) measure different things: records
+    adds disk reads, shuffle-permutation gathers, and decode to every
+    step, so a numeric diff between the two is meaningless rather than a
+    regression.  Returns the NOT-COMPARABLE fragment, or None when the
+    modes match (or either round predates the field)."""
+    a, b = old.get("input_mode"), new.get("input_mode")
+    if not isinstance(a, str) or not isinstance(b, str) or a == b:
+        return None
+    return f"input mode changed ({a} -> {b})"
 
 
 def bench_rounds(root: Path) -> list[Path]:
@@ -110,10 +125,22 @@ def main(argv: list[str] | None = None) -> int:
             verdicts.append((label, verdict))
     if isinstance(old.get("mode"), str) or isinstance(new.get("mode"), str):
         lines.append(f"  mode: {old.get('mode')} -> {new.get('mode')}")
+    if isinstance(old.get("input_mode"), str) or isinstance(
+        new.get("input_mode"), str
+    ):
+        lines.append(
+            f"  input mode: {old.get('input_mode')} -> {new.get('input_mode')}"
+        )
     regressed = [label for label, v in verdicts if v == "regressed"]
     improved = [label for label, v in verdicts if v == "improved"]
     mode_note = mode_regression(old, new)
-    if mode_note:
+    input_note = input_mode_mismatch(old, new)
+    if input_note:
+        # Different input paths: the numeric verdicts below are apples
+        # to oranges — say so instead of calling either direction a
+        # regression or an improvement.
+        headline = f"NOT COMPARABLE ({input_note})"
+    elif mode_note:
         # Name the dispatch-mode fallback explicitly: losing multi_step is
         # a regression even when every numeric metric reads flat.
         extra = f", {', '.join(regressed)}" if regressed else ""

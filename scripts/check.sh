@@ -30,13 +30,16 @@ python - <<'EOF' || exit 1
 # The gates this script newly depends on must actually have run: --all is
 # dynamic, so pin the serving SLO scenario, the control-plane failover
 # pair (broker-failover's 1k-agent soak, split-brain's epoch fencing),
-# and the telemetry/alerting gate (alert-storm: exactly-once alerts
-# through silent deaths, stragglers, and a broker failover).
+# the telemetry/alerting gate (alert-storm: exactly-once alerts
+# through silent deaths, stragglers, and a broker failover), and the
+# data-plane gate (data-reshard-live: live reshard mid-epoch over real
+# record shards, every record exactly once, bit-identical resume from
+# the v3 envelope).
 import json
 reports = json.load(open("/tmp/_chaos.json"))
 names = {r["scenario"] for r in reports}
 for required in ("serve-replica-loss", "broker-failover", "split-brain",
-                 "alert-storm"):
+                 "alert-storm", "data-reshard-live"):
     assert required in names, f"{required} missing from {sorted(names)}"
 EOF
 echo "chaos: all scenarios held their invariants (report: /tmp/_chaos.json)"

@@ -16,9 +16,13 @@ on virtual clocks, structural asserts only), a fleet-telemetry payload
 cost check (TELEM snapshots stay O(entries) with summaries truncated at
 the wire cap), an input-overlap stage (double-buffered stacked batches
 stay >= 2 deep on device, consumed stacks are freed by donate_buffers,
-and the consumer holds its single post-warmup compile), and an
-exact-match check of the audited train step's collective bytes against
-the committed comms budget (8-virtual-device runs only) ride along.
+and the consumer holds its single post-warmup compile), a datastream
+stage (per-host shard assignment is an exact partition, one epoch reads
+every record exactly once, and the async sharded checkpointer's save()
+provably never blocks a step — its writer is parked on a gate while the
+step path keeps enqueuing), and an exact-match check of the audited
+train step's collective bytes against the committed comms budget
+(8-virtual-device runs only) ride along.
 
 Exit 0 and one JSON line on success; exit 1 with a message on violation.
 """
@@ -439,6 +443,156 @@ def input_overlap() -> tuple[dict, list[str]]:
     }, failures
 
 
+DATASTREAM_SHARDS = 4
+DATASTREAM_HOSTS = ("host-a", "host-b")
+
+
+def datastream() -> tuple[dict, list[str]]:
+    """Data-plane stage: structural asserts only, no wall-clock.
+
+    Checks the three contracts docs/DATA.md promises: (1) the per-host
+    shard assignment is an exact partition of the shard set for every
+    epoch probed; (2) draining one epoch across all hosts reads every
+    record exactly once (record ids are baked into the shards, so the
+    claim is literally ``sorted(seen) == range(total)``); (3) the async
+    sharded checkpointer never blocks a step — proven by construction,
+    not by timing: the writer is parked on a threading.Event while the
+    step path keeps enqueuing, so zero bytes can land while the gate is
+    closed, latest-wins supersedes the middle save, and releasing the
+    gate commits exactly the first-picked and last-enqueued steps."""
+    import shutil
+    import tempfile
+    import threading
+
+    from deeplearning_cfn_tpu.train.datastream import (
+        AsyncShardedCheckpointer,
+        HostShardStream,
+        assign_shards,
+    )
+    from deeplearning_cfn_tpu.train.records import (
+        Field,
+        RecordSpec,
+        write_records,
+    )
+
+    failures: list[str] = []
+    for epoch in range(3):
+        assigned = assign_shards(
+            DATASTREAM_HOSTS, DATASTREAM_SHARDS, seed=7, epoch=epoch
+        )
+        flat = sorted(s for w in assigned.values() for s in w)
+        if flat != list(range(DATASTREAM_SHARDS)):
+            failures.append(
+                f"epoch {epoch}: shard assignment is not an exact "
+                f"partition: {assigned}"
+            )
+
+    spec = RecordSpec((Field("x", "uint8", (1,)), Field("y", "int32", ())))
+    root = Path(tempfile.mkdtemp(prefix="dlcfn-perf-datastream-"))
+    try:
+        gid = 0
+        paths = []
+        for sid in range(DATASTREAM_SHARDS):
+            recs = []
+            for _ in range(11 + sid):  # uneven on purpose
+                recs.append(
+                    spec.encode(
+                        x=np.array([gid % 251], np.uint8), y=np.int32(gid)
+                    )
+                )
+                gid += 1
+            p = root / f"shard-{sid}.dlc"
+            write_records(p, spec, recs)
+            paths.append(p)
+        seen: list[int] = []
+        for host in DATASTREAM_HOSTS:
+            stream = HostShardStream(
+                paths,
+                spec,
+                batch_size=4,
+                host=host,
+                hosts=DATASTREAM_HOSTS,
+                seed=7,
+                loop=False,
+            )
+            for b in stream.batches():
+                seen.extend(int(v) for v in b.y)
+        if sorted(seen) != list(range(gid)):
+            failures.append(
+                f"epoch drain not exactly-once: {len(seen)} reads of "
+                f"{gid} records"
+            )
+
+        class _GatedDisk:
+            """CheckpointIO-compatible; every write parks on a gate, so
+            the step path demonstrably runs ahead of the writer."""
+
+            def __init__(self):
+                self.entered = threading.Event()
+                self.release = threading.Event()
+
+            def write_bytes(self, path, data):
+                self.entered.set()
+                if not self.release.wait(timeout=30):
+                    raise OSError("gate never released")
+                Path(path).write_bytes(data)
+
+            def replace(self, src, dst):
+                import os
+
+                os.replace(src, dst)
+
+            def read_bytes(self, path):
+                return Path(path).read_bytes()
+
+        disk = _GatedDisk()
+        state = {"w": np.arange(8, dtype=np.float32)}
+        ck = AsyncShardedCheckpointer(
+            root / "ckpt", every_steps=1, n_shards=2, io=disk
+        )
+        ck.save(1, state, stream_state={"host": "host-a", "cursor": 1})
+        if not disk.entered.wait(timeout=30):
+            failures.append("async writer never started after save()")
+        # The step path is HERE, running, while the writer is parked on
+        # the gate: save() returned with zero bytes on disk.
+        if list((root / "ckpt").glob("ckpt-*.manifest.json")):
+            failures.append(
+                "a manifest landed while the writer was gated — "
+                "save() blocked on IO"
+            )
+        ck.save(2, {"w": state["w"] + 1})
+        ck.save(3, {"w": state["w"] + 2})
+        if ck.superseded_total != 1:
+            failures.append(
+                f"latest-wins supersede count {ck.superseded_total} != 1 "
+                "(step 2 should yield to step 3)"
+            )
+        disk.release.set()
+        ck.wait(timeout_s=60)
+        steps = ck.steps()
+        if steps != [1, 3]:
+            failures.append(
+                f"committed steps {steps} != [1, 3] "
+                "(first-picked + last-enqueued)"
+            )
+        restored = ck.restore_latest()
+        if restored is None or restored[1] != 3:
+            failures.append(
+                "restore_latest did not return the last committed step"
+            )
+        ck.close()
+        return {
+            "shards": DATASTREAM_SHARDS,
+            "hosts": len(DATASTREAM_HOSTS),
+            "records": gid,
+            "epoch_reads": len(seen),
+            "superseded": ck.superseded_total,
+            "committed_steps": steps,
+        }, failures
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 BROKER_SOAK_AGENTS = 1000
 BROKER_SOAK_SENDERS = 100
 
@@ -584,6 +738,9 @@ def main() -> int:
     telem_snap, telem_failures = telemetry_overhead()
     failures.extend(telem_failures)
 
+    datastream_snap, datastream_failures = datastream()
+    failures.extend(datastream_failures)
+
     comms_snap, comms_failures = comms_budget()
     failures.extend(comms_failures)
 
@@ -609,6 +766,7 @@ def main() -> int:
                 "serve": serve_snap,
                 "broker_failover": broker_snap,
                 "telemetry": telem_snap,
+                "datastream": datastream_snap,
                 "comms": comms_snap,
             },
             allow_nan=False,

@@ -92,3 +92,77 @@ def test_mode_regression_helper_direct():
     assert f({}, {"mode": "single_step"}) is None
     assert f({"mode": "multi_step_k2"}, {}) is None
     assert f({"mode": 4}, {"mode": "single_step"}) is None
+
+
+# --- input-mode comparability (PR 14 data plane) ----------------------------
+
+
+def test_input_mode_mismatch_is_not_comparable(tmp_path, capsys):
+    """synthetic -> records measures a different workload (disk reads,
+    permutation gathers, decode): the headline must refuse to diff, not
+    call the slower round a regression — and stay warn-only."""
+    _write_round(tmp_path, 3, {"mfu": 0.41, "input_mode": "synthetic"})
+    _write_round(tmp_path, 4, {"mfu": 0.33, "input_mode": "records"})
+    rc = bench_compare.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    headline = out.splitlines()[0]
+    assert "NOT COMPARABLE" in headline
+    assert "synthetic -> records" in headline
+    assert "REGRESSED" not in headline  # the refusal replaces the verdict
+    assert "input mode: synthetic -> records" in out
+
+
+def test_input_mode_mismatch_outranks_mode_regression(tmp_path, capsys):
+    """When BOTH the input path and the dispatch mode changed, nothing is
+    comparable — NOT COMPARABLE wins the headline over REGRESSED."""
+    _write_round(
+        tmp_path, 1,
+        {"mfu": 0.41, "mode": "multi_step_k4", "input_mode": "synthetic"},
+    )
+    _write_round(
+        tmp_path, 2,
+        {"mfu": 0.30, "mode": "single_step", "input_mode": "records"},
+    )
+    rc = bench_compare.main([str(tmp_path)])
+    headline = capsys.readouterr().out.splitlines()[0]
+    assert rc == 0
+    assert "NOT COMPARABLE" in headline and "REGRESSED" not in headline
+
+
+@pytest.mark.parametrize(
+    "old_mode,new_mode",
+    [
+        ("synthetic", "synthetic"),  # stable: diff normally
+        ("records", "records"),
+        (None, "records"),           # old round predates the field
+        ("synthetic", None),         # new round lost the field
+    ],
+)
+def test_matching_or_absent_input_mode_diffs_normally(
+    tmp_path, capsys, old_mode, new_mode
+):
+    old = {"mfu": 0.41}
+    new = {"mfu": 0.30}
+    if old_mode is not None:
+        old["input_mode"] = old_mode
+    if new_mode is not None:
+        new["input_mode"] = new_mode
+    _write_round(tmp_path, 1, old)
+    _write_round(tmp_path, 2, new)
+    rc = bench_compare.main([str(tmp_path)])
+    headline = capsys.readouterr().out.splitlines()[0]
+    assert rc == 0
+    assert "NOT COMPARABLE" not in headline
+    assert "REGRESSED" in headline  # the real MFU drop still gets named
+
+
+def test_input_mode_mismatch_helper_direct():
+    f = bench_compare.input_mode_mismatch
+    assert f({"input_mode": "synthetic"}, {"input_mode": "records"}) == (
+        "input mode changed (synthetic -> records)"
+    )
+    assert f({"input_mode": "records"}, {"input_mode": "records"}) is None
+    assert f({}, {"input_mode": "records"}) is None
+    assert f({"input_mode": "synthetic"}, {}) is None
+    assert f({"input_mode": 3}, {"input_mode": "records"}) is None

@@ -1,0 +1,539 @@
+"""Sharded streaming data plane + async sharded checkpointing
+(train/datastream — docs/DATA.md).
+
+Four property groups, matching the subsystem's seams:
+
+- assignment math: pure functions of (seed, epoch, topology) — exact
+  partition, host-independent record permutations, reshard reassignment.
+- HostShardStream: exactly-once per epoch, StreamState JSON round-trip
+  resume that reproduces the straight run's batches bit-for-bit.
+- DataStreamPlane: live reshard with zero dropped/duplicated records,
+  telemetry that never counts backwards.
+- AsyncShardedCheckpointer: bit-exact pytree round-trip (float32 /
+  bfloat16 / int32), non-blocking save with latest-wins supersede
+  (proven structurally with a gated disk, no timing), crash mid-manifest
+  leaving the previous checkpoint restorable, and the v3 envelope's
+  topology/stream-state fields end to end.
+"""
+
+import json
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearning_cfn_tpu.chaos.injectors import ManifestCrashDisk
+from deeplearning_cfn_tpu.train.checkpoint import (
+    CheckpointIO,
+    StateCheckpointer,
+    TopologyMismatch,
+    _envelope,
+    _open_envelope,
+)
+from deeplearning_cfn_tpu.train.datastream import (
+    AsyncShardedCheckpointer,
+    DataStreamPlane,
+    HostShardStream,
+    ShardWork,
+    StreamState,
+    assign_shards,
+    decode_tree,
+    encode_tree,
+    reassign_remaining,
+    record_permutation,
+    shard_permutation,
+)
+from deeplearning_cfn_tpu.train.records import Field, RecordSpec, write_records
+
+SPEC = RecordSpec((Field("x", "uint8", (2,)), Field("y", "int32", ())))
+
+
+def _shards(tmp_path, sizes):
+    """DLC1 shard files whose y field is the GLOBAL record id — the
+    exactly-once assertions below are literal set comparisons."""
+    paths, gid = [], 0
+    for sid, n in enumerate(sizes):
+        recs = []
+        for _ in range(n):
+            recs.append(
+                SPEC.encode(x=np.full((2,), gid % 256, np.uint8), y=np.int32(gid))
+            )
+            gid += 1
+        path = tmp_path / f"shard-{sid:02d}.dlc"
+        write_records(path, SPEC, recs)
+        paths.append(path)
+    return paths, gid
+
+
+class FakeContract:
+    """Duck-typed ClusterContract: the plane only calls datastream_hosts()."""
+
+    def __init__(self, hosts):
+        self._hosts = tuple(hosts)
+
+    def datastream_hosts(self):
+        return self._hosts
+
+
+# --- assignment math --------------------------------------------------------
+
+
+def test_shard_permutation_seeded_and_epoch_varying():
+    a = shard_permutation(7, 0, 16)
+    assert a == shard_permutation(7, 0, 16)  # pure function of the key
+    assert sorted(a) == list(range(16))
+    assert a != shard_permutation(7, 1, 16)  # epochs reshuffle
+    assert a != shard_permutation(8, 0, 16)  # seeds differ
+
+
+def test_record_permutation_is_host_independent():
+    """Keyed by (seed, epoch, shard) only — the property that lets a
+    survivor continue a lost host's half-read shard from its offset."""
+    a = record_permutation(3, 1, 2, 32)
+    b = record_permutation(3, 1, 2, 32)
+    np.testing.assert_array_equal(a, b)
+    assert sorted(a.tolist()) == list(range(32))
+    assert record_permutation(3, 1, 5, 32).tolist() != a.tolist()
+
+
+@pytest.mark.parametrize(
+    "n_hosts,n_shards",
+    [(1, 5), (2, 6), (3, 7), (4, 4), (5, 3)],  # incl. more hosts than shards
+)
+def test_assign_shards_exact_partition(n_hosts, n_shards):
+    hosts = [f"h{i}" for i in range(n_hosts)]
+    for epoch in range(3):
+        assigned = assign_shards(hosts, n_shards, seed=11, epoch=epoch)
+        flat = [s for host in hosts for s in assigned[host]]
+        assert sorted(flat) == list(range(n_shards))  # exact, never off by one
+
+
+def test_assign_shards_validation():
+    with pytest.raises(ValueError, match="at least one host"):
+        assign_shards([], 4, 0, 0)
+    with pytest.raises(ValueError, match="duplicate"):
+        assign_shards(["a", "a"], 4, 0, 0)
+    with pytest.raises(ValueError, match="positive"):
+        shard_permutation(0, 0, 0)
+
+
+def test_reassign_remaining_covers_unfinished_work():
+    sizes = {0: 10, 1: 8, 2: 6, 3: 12}
+    progress = {0: 10, 1: 3, 3: 0}  # shard 0 done, 1 mid-read, 2/3 untouched
+    work = reassign_remaining(5, 0, 4, progress, sizes, ["a", "b"])
+    flat = [w for ws in work.values() for w in ws]
+    assert {w.shard_id for w in flat} == {1, 2, 3}  # finished shard excluded
+    by_id = {w.shard_id: w.offset for w in flat}
+    assert len(by_id) == len(flat)  # each shard goes to exactly one survivor
+    assert by_id == {1: 3, 2: 0, 3: 0}  # offsets continue the recorded cursor
+
+
+def test_reassign_remaining_validation():
+    with pytest.raises(ValueError, match="survivor"):
+        reassign_remaining(0, 0, 1, {}, {0: 4}, [])
+    with pytest.raises(ValueError, match="exceeds size"):
+        reassign_remaining(0, 0, 1, {0: 9}, {0: 4}, ["a"])
+
+
+# --- HostShardStream --------------------------------------------------------
+
+
+def test_stream_exactly_once_per_epoch(tmp_path):
+    paths, total = _shards(tmp_path, [13, 7, 9, 11])
+    hosts = ("h0", "h1", "h2")
+    seen = []
+    for host in hosts:
+        stream = HostShardStream(
+            paths, SPEC, batch_size=4, host=host, hosts=hosts, seed=3, loop=False
+        )
+        seen.extend(int(y) for b in stream.batches() for y in b.y)
+    assert sorted(seen) == list(range(total))
+
+
+def test_more_hosts_than_shards_empty_stream_terminates(tmp_path):
+    """A host assigned zero shards must yield nothing and STOP — an empty
+    work list with loop=True would otherwise spin forever.  With 3 hosts
+    over 2 shards, positional assignment leaves host 2 empty EVERY epoch
+    (position 2 of a 2-element permutation never exists)."""
+    paths, total = _shards(tmp_path, [6, 6])
+    hosts = ("h0", "h1", "h2")
+    counts = {}
+    for host in hosts:
+        stream = HostShardStream(
+            paths, SPEC, batch_size=3, host=host, hosts=hosts, seed=0, loop=True
+        )
+        counts[host] = len(list(stream.batches(10)))  # returns, never spins
+    assert counts["h2"] == 0
+    assert counts["h0"] == counts["h1"] == 10  # owners loop across epochs
+
+
+def test_stream_state_json_roundtrip_resumes_exactly(tmp_path):
+    """to_json -> from_json in a FRESH stream continues the straight
+    run's batch sequence bit-for-bit, across the epoch boundary."""
+    paths, _ = _shards(tmp_path, [10, 14])
+    kw = dict(spec=SPEC, batch_size=4, host="h0", hosts=("h0",), seed=9, loop=True)
+    straight = HostShardStream(paths, **kw)
+    want = [b.y.tolist() for b in straight.batches(12)]  # 24 recs/epoch -> crosses
+
+    head = HostShardStream(paths, **kw)
+    got = [b.y.tolist() for b in head.batches(5)]
+    doc = json.loads(json.dumps(head.stream_state().to_json()))  # the envelope trip
+    resumed = HostShardStream(paths, state=doc, **kw)
+    got += [b.y.tolist() for b in resumed.batches(7)]
+    assert got == want
+    assert resumed.records_total == sum(len(b) for b in want)
+
+
+def test_stream_validation(tmp_path):
+    paths, _ = _shards(tmp_path, [8])
+    kw = dict(spec=SPEC, batch_size=4, host="h0", hosts=("h0",), seed=1)
+    with pytest.raises(ValueError, match="batch_size"):
+        HostShardStream(paths, SPEC, 0, host="h0", hosts=("h0",))
+    with pytest.raises(ValueError, match="not in topology"):
+        HostShardStream(paths, SPEC, 4, host="h9", hosts=("h0",))
+    other = StreamState(seed=2, epoch=0, host="h0", work=()).to_json()
+    with pytest.raises(ValueError, match="seed"):
+        HostShardStream(paths, state=other, **kw)
+    wrong_host = StreamState(seed=1, epoch=0, host="h1", work=()).to_json()
+    with pytest.raises(ValueError, match="host"):
+        HostShardStream(paths, state=wrong_host, **kw)
+
+
+# --- DataStreamPlane --------------------------------------------------------
+
+
+def test_plane_reshard_is_exactly_once(tmp_path):
+    """Lose half the hosts mid-epoch: the union of everything consumed
+    before and after the reshard is every record exactly once."""
+    paths, total = _shards(tmp_path, [9, 12, 7, 10, 8])
+    plane = DataStreamPlane(
+        FakeContract(("h0", "h1", "h2", "h3")), paths, SPEC,
+        batch_size=4, seed=2, loop=False,
+    )
+    seen: list[int] = []
+    iters = {h: plane.stream(h).batches() for h in plane.hosts}
+    for _ in range(2):  # a couple of interleaved rounds before the loss
+        for it in iters.values():
+            batch = next(it, None)
+            if batch is not None:
+                seen.extend(int(y) for y in batch.y)
+    plane.reshard(FakeContract(("h0", "h2")))
+    for host in ("h0", "h2"):
+        seen.extend(int(y) for b in iters[host] for y in b.y)
+    assert sorted(seen) == list(range(total))
+    assert plane.reshards == 1
+
+
+def test_plane_snapshot_never_counts_backwards(tmp_path):
+    """Records consumed by a host that later left the plane stay in
+    records_total — its stream is deleted at reshard, its throughput
+    is not (regression: the retired-records accumulator)."""
+    paths, total = _shards(tmp_path, [8, 8])
+    plane = DataStreamPlane(
+        FakeContract(("h0", "h1")), paths, SPEC, batch_size=4, seed=0, loop=False
+    )
+    eaten = sum(len(next(plane.stream(h).batches(1)).y) for h in ("h0", "h1"))
+    before = plane.snapshot()["records_total"]
+    assert before == eaten
+    plane.reshard(FakeContract(("h0",)))
+    assert plane.snapshot()["records_total"] == before
+    rest = sum(len(b.y) for b in plane.stream("h0").batches())
+    assert plane.snapshot()["records_total"] == before + rest == total
+
+
+def test_plane_reshard_epoch_mismatch_raises(tmp_path):
+    """Hosts mid-epoch on different epochs is a protocol violation: the
+    merged progress map would mix two different shard permutations."""
+    paths, _ = _shards(tmp_path, [4, 12])
+    plane = DataStreamPlane(
+        FakeContract(("h0", "h1")), paths, SPEC, batch_size=4, seed=1, loop=True
+    )
+    # Drive ONE host across its epoch boundary (each host owns one shard;
+    # the smaller one drains within a few batches).
+    fast = min(plane.hosts, key=lambda h: plane.stream(h).records_per_epoch)
+    it = plane.stream(fast).batches()
+    for _ in range(64):
+        next(it)
+        if plane.stream(fast).epoch > 0:
+            break
+    assert plane.stream(fast).epoch > 0
+    with pytest.raises(ValueError, match="epoch"):
+        plane.reshard(FakeContract((fast,)))
+
+
+# --- exact pytree <-> JSON codec --------------------------------------------
+
+
+def _tree():
+    import ml_dtypes
+
+    return {
+        "w": np.array([0.1, 1 / 3, -2.5e-8, 3.4e38], np.float32),
+        "b": np.array([1.0, -0.00731], np.float64).astype(ml_dtypes.bfloat16),
+        "step": np.int32(17),
+    }
+
+
+def test_encode_decode_tree_bit_exact():
+    tree = _tree()
+    docs = json.loads(json.dumps(encode_tree(tree)))  # through real JSON
+    out = decode_tree(tree, docs)
+    for key in tree:
+        a, b = np.asarray(tree[key]), np.asarray(out[key])
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert a.tobytes() == b.tobytes()  # bit-exact, not allclose
+
+
+def test_decode_tree_leaf_count_mismatch_raises():
+    docs = encode_tree({"a": np.zeros(3, np.float32)})
+    with pytest.raises(ValueError, match="leaves"):
+        decode_tree({"a": np.zeros(3), "b": np.zeros(2)}, docs)
+
+
+# --- AsyncShardedCheckpointer ----------------------------------------------
+
+
+TOPO = {"devices": 8, "axes": {"dp": 8}}
+
+
+def test_async_ckpt_roundtrip_with_stream_state(tmp_path):
+    tree = _tree()
+    ss = {"host": "h0", "epoch": 1, "work": [[2, 5]]}
+    with AsyncShardedCheckpointer(tmp_path, n_shards=3) as ck:
+        ck.save(4, tree, mesh_topology=TOPO, stream_state=ss)
+        ck.wait()
+        assert ck.writes_total == 1 and ck.write_failures == 0
+        got = ck.restore_latest(template=_tree(), expected_topology=TOPO)
+        assert got is not None
+        state, step = got
+        assert step == 4
+        assert ck.last_stream_state == ss
+        for key in tree:
+            assert np.asarray(state[key]).tobytes() == np.asarray(tree[key]).tobytes()
+            assert np.asarray(state[key]).dtype == np.asarray(tree[key]).dtype
+
+
+def test_async_ckpt_save_snapshots_before_write(tmp_path):
+    """The step path DONATES/mutates state right after save() — the
+    enqueued snapshot must be immune (regression: by-reference enqueue
+    handed the writer buffers the next step had already reused)."""
+    state = {"w": np.arange(6, dtype=np.float32)}
+    with AsyncShardedCheckpointer(tmp_path, n_shards=2) as ck:
+        ck.save(1, state)
+        state["w"] *= -1.0  # the step loop moving on
+        ck.wait()
+        restored, step = ck.restore_latest(template={"w": np.zeros(6, np.float32)})
+    assert step == 1
+    np.testing.assert_array_equal(restored["w"], np.arange(6, dtype=np.float32))
+
+
+class _GatedDisk(CheckpointIO):
+    """Parks the writer thread inside its first write until released —
+    save() returning while the disk is wedged proves non-blocking
+    structurally, no wall-clock assertions."""
+
+    def __init__(self):
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def write_bytes(self, path: Path, data: bytes) -> None:
+        self.entered.set()
+        assert self.release.wait(timeout=30.0)
+        Path(path).write_bytes(data)
+
+
+def test_async_ckpt_save_never_blocks_and_latest_wins(tmp_path):
+    disk = _GatedDisk()
+    ck = AsyncShardedCheckpointer(tmp_path, n_shards=2, io=disk)
+    try:
+        ck.save(1, {"w": np.arange(4, dtype=np.float32)})
+        assert disk.entered.wait(timeout=30.0)  # writer is wedged on disk
+        # The step path keeps going: both saves return instantly, step 2's
+        # pending slot is superseded by step 3 (latest wins, journaled).
+        ck.save(2, {"w": np.arange(4, dtype=np.float32) + 2})
+        ck.save(3, {"w": np.arange(4, dtype=np.float32) + 3})
+        assert ck.superseded_total == 1
+        assert not list(Path(tmp_path).glob("*.manifest.json"))  # nothing landed yet
+        disk.release.set()
+        ck.wait(timeout_s=60.0)
+    finally:
+        disk.release.set()
+        ck.close()
+    assert ck.steps() == [1, 3]  # 2 was never written
+    restored = ck.restore_latest(template={"w": np.zeros(4, np.float32)})
+    assert restored is not None and restored[1] == 3
+    np.testing.assert_array_equal(restored[0]["w"], np.arange(4, dtype=np.float32) + 3)
+
+
+def test_async_ckpt_crash_mid_manifest_previous_restorable(tmp_path):
+    """A writer dying at the manifest commit point costs freshness only:
+    shard litter for the torn step is on disk, the manifest is not, and
+    restore_latest returns the previous checkpoint bit-equal."""
+    disk = ManifestCrashDisk()
+    tree = {"w": np.arange(12, dtype=np.float32).reshape(3, 4)}
+    with AsyncShardedCheckpointer(
+        tmp_path, n_shards=2, io=disk
+    ) as ck:
+        ck.save(1, tree, mesh_topology=TOPO, stream_state={"host": "h0"})
+        ck.wait()
+        disk.arm()
+        ck.save(2, {"w": tree["w"] + 1})
+        ck.wait()
+        assert ck.write_failures == 1 and disk.crashes == 1
+        assert ck.steps() == [1]  # step 2 never committed
+        litter = list(Path(tmp_path).glob("ckpt-00000002.shard-*.json"))
+        assert litter  # realistic: shards landed before the crash
+        restored = ck.restore_latest(
+            template={"w": np.zeros((3, 4), np.float32)}, expected_topology=TOPO
+        )
+        assert restored is not None and restored[1] == 1
+        np.testing.assert_array_equal(restored[0]["w"], tree["w"])
+        assert ck.last_stream_state == {"host": "h0"}
+
+
+def test_async_ckpt_restore_skips_torn_shards(tmp_path):
+    """A shard whose bytes rot below the manifest's sha256 makes the
+    whole step invisible — restore falls back to the previous step."""
+    with AsyncShardedCheckpointer(tmp_path, n_shards=2) as ck:
+        ck.save(1, {"w": np.arange(4, dtype=np.float32)})
+        ck.wait()
+        ck.save(2, {"w": np.arange(4, dtype=np.float32) + 9})
+        ck.wait()
+        shard = next(Path(tmp_path).glob("ckpt-00000002.shard-00-*.json"))
+        shard.write_bytes(b'{"corrupt": true}')
+        restored = ck.restore_latest(template={"w": np.zeros(4, np.float32)})
+    assert restored is not None and restored[1] == 1
+
+
+def test_async_ckpt_topology_guard(tmp_path):
+    with AsyncShardedCheckpointer(tmp_path, n_shards=2) as ck:
+        ck.save(1, {"w": np.zeros(2, np.float32)}, mesh_topology=TOPO)
+        ck.wait()
+        with pytest.raises(TopologyMismatch):
+            ck.restore_latest(expected_topology={"devices": 4, "axes": {"fsdp": 4}})
+
+
+def test_async_ckpt_gc_keeps_max_to_keep(tmp_path):
+    with AsyncShardedCheckpointer(tmp_path, n_shards=2, max_to_keep=2) as ck:
+        for step in range(1, 6):
+            ck.save(step, {"w": np.full(3, step, np.float32)})
+            ck.wait()
+    assert ck.steps() == [4, 5]
+    # GC removed the stale shards too, not just the manifests.
+    assert not list(Path(tmp_path).glob("ckpt-00000001.*"))
+    assert not list(Path(tmp_path).glob("ckpt-00000003.*"))
+
+
+def test_async_ckpt_save_after_close_raises_and_empty_restore(tmp_path):
+    ck = AsyncShardedCheckpointer(tmp_path / "a", n_shards=1)
+    assert ck.restore_latest() is None and ck.latest_step() is None
+    ck.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        ck.save(1, {"w": np.zeros(1, np.float32)})
+
+
+def test_async_ckpt_rejects_bad_shard_count(tmp_path):
+    with pytest.raises(ValueError, match="n_shards"):
+        AsyncShardedCheckpointer(tmp_path, n_shards=0)
+
+
+# --- v3 checkpoint envelope -------------------------------------------------
+
+
+def test_envelope_versions_are_mutually_compatible():
+    """sha256 covers the STATE body only, so every direction round-trips:
+    a v1-style envelope (no optional fields) opens with no topology and
+    no stream state; a v3 envelope carries both; corruption still fails."""
+    state = {"loss": 0.5, "step": 3}
+    v1 = _envelope(7, state)
+    assert json.loads(v1.decode()).get("version") is None  # genuinely v1-shaped
+    assert _open_envelope(v1) == (state, 7, None, None)
+
+    v3 = _envelope(7, state, mesh_topology=TOPO, stream_state={"host": "h0"})
+    opened = _open_envelope(v3)
+    assert opened == (state, 7, TOPO, {"host": "h0"})
+
+    # A v2-era reader is this same parser ignoring the extra key — prove
+    # the optional fields sit OUTSIDE the hashed body by stripping them.
+    env = json.loads(v3.decode())
+    del env["stream_state"]
+    stripped = _open_envelope(json.dumps(env).encode())
+    assert stripped == (state, 7, TOPO, None)
+
+    env["state"]["loss"] = 0.6  # tamper INSIDE the body -> hash fails
+    assert _open_envelope(json.dumps(env).encode()) is None
+
+
+def test_state_checkpointer_v3_stream_state_roundtrip(tmp_path):
+    ck = StateCheckpointer(tmp_path)
+    ss = {"host": "h0", "epoch": 2, "work": [[1, 4]], "records_total": 96}
+    ck.save(5, {"k": 1}, mesh_topology=TOPO, stream_state=ss)
+    fresh = StateCheckpointer(tmp_path)  # a new process restoring
+    state, step = fresh.restore_latest(expected_topology=TOPO)
+    assert (state, step) == ({"k": 1}, 5)
+    assert fresh.last_stream_state == ss
+
+
+def test_state_checkpointer_v2_envelope_has_no_stream_state(tmp_path):
+    """Restoring a pre-datastream checkpoint must leave last_stream_state
+    None — the trainer then starts the data plane fresh, not garbage."""
+    ck = StateCheckpointer(tmp_path)
+    ck.save(3, {"k": 2}, mesh_topology=TOPO)  # v2-style: topology only
+    fresh = StateCheckpointer(tmp_path)
+    fresh.last_stream_state = {"stale": True}
+    assert fresh.restore_latest() == ({"k": 2}, 3)
+    assert fresh.last_stream_state is None
+
+
+# --- status fold + Prometheus gauges ----------------------------------------
+
+
+def test_datastream_events_fold_to_prometheus_gauges(tmp_path):
+    """The plane's journaled events fold into the `dlcfn status` shape
+    and render as dlcfn_datastream_* gauges — the observability seam the
+    check.sh data-plane gate depends on."""
+    from deeplearning_cfn_tpu.obs.exporter import (
+        METRIC_REGISTRY,
+        fold_datastream_events,
+        render_prometheus,
+    )
+
+    events = [
+        {"kind": "datastream", "event": "progress", "hosts": 2, "shards": 4,
+         "records_total": 96, "records_per_s": 120.5, "shard_lag": 3,
+         "reshards": 1, "epoch": 0},
+        {"kind": "datastream", "event": "host_progress", "host": "h0",
+         "records": 50, "remaining": 2, "epoch": 0},
+        {"kind": "datastream", "event": "reshard", "epoch": 0,
+         "lost_hosts": ["h1"], "survivors": ["h0"], "work_units": 2,
+         "records_remaining": 10},
+        {"kind": "datastream", "event": "checkpoint_write", "step": 4,
+         "seconds": 0.031, "shards": 2, "leaves": 6},
+        {"kind": "datastream", "event": "checkpoint_superseded", "step": 2,
+         "by": 4},
+        {"kind": "datastream", "event": "native_fallback", "error": "no cc"},
+        {"kind": "other", "event": "progress"},  # wrong kind: ignored
+    ]
+    folded = fold_datastream_events(events)
+    assert folded["progress"]["records_total"] == 96
+    assert folded["reshard_total"] == 1
+    assert folded["checkpoint"]["writes"] == 1
+    assert folded["checkpoint"]["superseded"] == 1
+    assert folded["checkpoint"]["last_write_seconds"] == 0.031
+    assert folded["native_fallback_total"] == 1
+
+    text = render_prometheus(None, None, datastream=folded, cluster="c1")
+    for name, want in (
+        ("dlcfn_datastream_records_per_s", "120.5"),
+        ("dlcfn_datastream_records_total", "96"),
+        ("dlcfn_datastream_shard_lag", "3"),
+        ("dlcfn_datastream_reshard_total", "1"),
+        ("dlcfn_datastream_checkpoint_write_seconds", "0.031"),
+        ("dlcfn_datastream_checkpoint_writes_total", "1"),
+        ("dlcfn_datastream_native_fallback_total", "1"),
+    ):
+        assert name in METRIC_REGISTRY  # every emitted family is registered
+        assert f'{name}{{cluster="c1"}} {want}' in text
+
+    assert fold_datastream_events([{"kind": "other"}]) == {}

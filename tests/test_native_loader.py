@@ -361,3 +361,161 @@ def test_resume_without_shuffle(tmp_path):
     ) as loader:
         batch = next(iter(loader.batches(1)))
     assert batch.y.tolist() == [8, 9, 10, 11]
+
+
+# --- typed shard errors + the pure-Python fallback (PR 14 data plane) --------
+
+
+def test_write_records_torn_write_leaves_nothing(tmp_path):
+    """A writer torn mid-stream (raising generator = crash analog) must
+    leave NOTHING at the destination and no temp litter — the atomicio
+    route means read_header can never accept a half-written shard."""
+
+    def torn():
+        yield SPEC.encode(x=np.zeros((4,), np.float32), y=np.int32(0))
+        raise RuntimeError("staging host died")
+
+    path = tmp_path / "torn.dlc"
+    with pytest.raises(RuntimeError, match="staging host died"):
+        write_records(path, SPEC, torn())
+    assert not path.exists()
+    assert list(tmp_path.iterdir()) == []  # no dot-temp left behind
+
+
+def test_missing_shard_is_typed(tmp_path):
+    from deeplearning_cfn_tpu.train.native_loader import (
+        ShardFileError,
+        validate_shards,
+    )
+
+    ghost = tmp_path / "ghost.dlc"
+    with pytest.raises(ShardFileError) as exc:
+        validate_shards([ghost], SPEC)
+    assert exc.value.reason == "missing"
+    assert exc.value.path == ghost
+
+
+def test_truncated_shard_is_typed(tmp_path):
+    """Header promises more records than the payload holds (torn copy,
+    partial download): typed 'truncated', on every backend."""
+    from deeplearning_cfn_tpu.train.native_loader import (
+        PythonRecordLoader,
+        ShardFileError,
+        validate_shards,
+    )
+
+    path = _write(tmp_path, "a.dlc", range(8))
+    full = path.stat().st_size
+    with open(path, "r+b") as f:
+        f.truncate(full - SPEC.record_size)  # lop one record off the tail
+    with pytest.raises(ShardFileError) as exc:
+        validate_shards([path], SPEC)
+    assert exc.value.reason == "truncated"
+    with pytest.raises(ShardFileError):
+        PythonRecordLoader([path], SPEC, batch_size=4)
+
+
+def test_python_loader_parity_exactly_once_and_disjoint(tmp_path):
+    """The fallback honors the native loader's contract: exactly-once
+    coverage per epoch and disjoint round-robin sharding."""
+    from deeplearning_cfn_tpu.train.native_loader import PythonRecordLoader
+
+    paths = [
+        _write(tmp_path, "a.dlc", range(0, 13)),
+        _write(tmp_path, "b.dlc", range(13, 29)),
+    ]
+    with PythonRecordLoader(
+        paths, SPEC, batch_size=4, shuffle=True, drop_remainder=False, loop=False
+    ) as loader:
+        seen = [int(y) for b in loader.batches() for y in b.y]
+    assert sorted(seen) == list(range(29))
+
+    shards = []
+    for shard in range(2):
+        with PythonRecordLoader(
+            paths, SPEC, batch_size=2, shard_index=shard, shard_count=2,
+            shuffle=False, drop_remainder=False, loop=False,
+        ) as loader:
+            shards.append({int(y) for b in loader.batches() for y in b.y})
+    assert shards[0].isdisjoint(shards[1])
+    assert shards[0] | shards[1] == set(range(29))
+
+
+def test_python_loader_resume_and_seeded_shuffle(tmp_path):
+    from deeplearning_cfn_tpu.train.native_loader import PythonRecordLoader
+
+    path = _write(tmp_path, "a.dlc", range(32))  # 8 batches/epoch at 4
+
+    def read(start, n):
+        with PythonRecordLoader(
+            [path], SPEC, batch_size=4, shuffle=True, loop=True, seed=3,
+            start_batch=start,
+        ) as loader:
+            return [b.y.tolist() for b in loader.batches(n)]
+
+    straight = read(0, 12)
+    assert read(0, 12) == straight            # same seed -> same stream
+    assert read(5, 7) == straight[5:12]       # resume crosses the epoch
+    epoch0 = sorted(y for b in straight[:8] for y in b)
+    assert epoch0 == list(range(32))          # exactly-once per epoch
+
+
+def test_open_record_loader_falls_back_and_journals(tmp_path, monkeypatch):
+    """A native-loader build failure degrades to PythonRecordLoader and
+    journals one ``datastream`` / ``native_fallback`` event — a slower
+    input path must be visible in `dlcfn status --journal`, not silent."""
+    from deeplearning_cfn_tpu.obs.recorder import get_recorder
+    from deeplearning_cfn_tpu.train import native_loader
+    from deeplearning_cfn_tpu.train.native_loader import (
+        PythonRecordLoader,
+        open_record_loader,
+    )
+
+    path = _write(tmp_path, "a.dlc", range(16))
+
+    def no_compiler():
+        raise LoaderError("building native loader failed: no c++ toolchain")
+
+    monkeypatch.setattr(native_loader, "_load_library", no_compiler)
+    before = sum(
+        1
+        for e in get_recorder().tail(8192)
+        if e.get("kind") == "datastream" and e.get("event") == "native_fallback"
+    )
+    loader = open_record_loader([path], SPEC, batch_size=4, loop=False)
+    assert isinstance(loader, PythonRecordLoader)
+    with loader:
+        seen = [int(y) for b in loader.batches() for y in b.y]
+    assert sorted(seen) == list(range(16))
+    events = [
+        e
+        for e in get_recorder().tail(8192)
+        if e.get("kind") == "datastream" and e.get("event") == "native_fallback"
+    ]
+    assert len(events) == before + 1
+    assert "toolchain" in events[-1]["error"]
+
+
+def test_open_record_loader_force_python_and_typed_errors(tmp_path, monkeypatch):
+    """force_python skips the native attempt entirely; a DATA failure
+    (missing shard) raises typed on the entry point — the fallback is
+    for loader failures, never a mask over bad shards."""
+    from deeplearning_cfn_tpu.train import native_loader
+    from deeplearning_cfn_tpu.train.native_loader import (
+        PythonRecordLoader,
+        ShardFileError,
+        open_record_loader,
+    )
+
+    path = _write(tmp_path, "a.dlc", range(8))
+
+    def explode():  # force_python must never reach the native path
+        raise AssertionError("native path used despite force_python")
+
+    monkeypatch.setattr(native_loader, "_load_library", explode)
+    loader = open_record_loader([path], SPEC, batch_size=4, force_python=True)
+    assert isinstance(loader, PythonRecordLoader)
+    loader.close()
+
+    with pytest.raises(ShardFileError):
+        open_record_loader([tmp_path / "ghost.dlc"], SPEC, batch_size=4)
