@@ -549,6 +549,20 @@ class SimBrokerNode(SimBroker):
         if seq > self.sync_seq:
             self._apply_frame(frame)
             self.sync_seq = seq
+            # Journal the replicated entry at its INCOMING seq/epoch: the
+            # standby keeps a complete copy of the history it applied, so
+            # after ITS promotion it can re-provision a fresh standby and
+            # resume replication from its own journal (the self-healing
+            # half of the pair).  Seq-faithful, so a re-ship of the same
+            # history dedups exactly like the original stream.
+            self.journal.append(
+                {
+                    "seq": seq,
+                    "epoch": epoch,
+                    "ts": self._clock.now(),
+                    "frame": frame,
+                }
+            )
         return seq
 
     def promote(self, epoch: int) -> int:
@@ -583,10 +597,16 @@ class ReplicatedSimBroker:
     primary, with ``demote()`` modelling the deposed node standing down
     once fenced."""
 
-    def __init__(self, clock: VirtualClock):
+    def __init__(
+        self,
+        clock: VirtualClock,
+        primary_name: str = "broker-a",
+        standby_name: str = "broker-b",
+    ):
         self.clock = clock
-        self.primary = SimBrokerNode(clock, "broker-a", role="primary")
-        self.standby = SimBrokerNode(clock, "broker-b", role="standby")
+        self.primary = SimBrokerNode(clock, primary_name, role="primary")
+        self.standby = SimBrokerNode(clock, standby_name, role="standby")
+        self.reprovisions = 0  # fresh standbys spawned by auto-heal
 
     def nodes(self) -> list[SimBrokerNode]:
         return [self.primary, self.standby]
@@ -632,7 +652,17 @@ class ReplicatedSimBroker:
         if max_entries is not None:
             todo = todo[:max_entries]
         for entry in todo:
-            dst.sync(entry["epoch"], entry["seq"], entry["frame"])
+            # Ship under the SENDER's current term (never below the
+            # entry's own): a promoted primary re-replays old-term
+            # history to a fresh standby under its new epoch, while a
+            # deposed primary's stream still carries its stale epoch and
+            # fences.  SYNC's epoch names the stream's term, not the
+            # entry's origin.
+            dst.sync(
+                max(int(entry["epoch"]), src.epoch),
+                entry["seq"],
+                entry["frame"],
+            )
         return len(todo)
 
     def kill_primary(self) -> None:
@@ -641,6 +671,28 @@ class ReplicatedSimBroker:
     def promote_standby(self) -> int:
         epoch = max(self.primary.epoch, self.standby.epoch) + 1
         return self.standby.promote(epoch)
+
+    def reprovision_standby(self, name: str | None = None) -> SimBrokerNode:
+        """Auto-heal after a failover: the acting primary spawns a FRESH
+        standby at its own epoch and replays its full journal into it —
+        the sim twin of ``_adopt_standby``'s re-provision step.  The
+        deposed node is never reused; ``primary``/``standby`` are
+        re-pointed so the pair is whole again (``pending()`` == 0 once
+        the replay completes, which this method runs to the end)."""
+        acting = self.active()
+        if acting is None:
+            raise SimBrokerError("no live primary to re-provision from")
+        fresh = SimBrokerNode(
+            self.clock,
+            name or f"{acting.name}+standby{self.reprovisions}",
+            role="standby",
+            epoch=acting.epoch,
+        )
+        self.primary = acting
+        self.standby = fresh
+        self.reprovisions += 1
+        self.stream()  # resume replication from the promoted journal
+        return fresh
 
     def demote(self, node: SimBrokerNode) -> None:
         """A fenced ex-primary stands down (what the real deposed broker
@@ -659,10 +711,14 @@ class FailoverSimConnection:
 
     def __init__(
         self,
-        nodes: Sequence[SimBrokerNode],
+        nodes: Sequence[SimBrokerNode] | None = None,
         fail_when: Callable[[], bool] | None = None,
+        nodes_source: Callable[[], Sequence[SimBrokerNode]] | None = None,
     ):
-        self._nodes = list(nodes)
+        if nodes is None and nodes_source is None:
+            raise ValueError("need nodes or nodes_source")
+        self._nodes = list(nodes) if nodes is not None else []
+        self._nodes_source = nodes_source
         self._fail_when = fail_when
         self.closed = False
         self.failovers = 0
@@ -672,6 +728,12 @@ class FailoverSimConnection:
             raise SimBrokerError("connection is closed")
         if self._fail_when is not None and self._fail_when():
             raise SimBrokerError("network partition")
+        if self._nodes_source is not None:
+            # Re-read the endpoint list each call — the sim twin of
+            # FailoverBrokerConnection's endpoints_source refresh: a
+            # client started before a failover finds the fresh
+            # auto-re-provisioned standby without a restart.
+            self._nodes = list(self._nodes_source())
         last: Exception | None = None
         for i, node in enumerate(self._nodes):
             try:
@@ -848,5 +910,368 @@ def soak_failover(
         "epoch": epoch,
         "fenced_writes": cluster.primary.fenced + cluster.standby.fenced,
         "client_failovers": resend.failovers,
+        "rounds": 6 + drain_rounds,
+    }
+
+
+def _shard_for_key(key: str, n_shards: int) -> int:
+    """The production hash ring — ONE routing function shared by the
+    real client and the sim, so a schedule proven here routes identically
+    against the sharded binary fleet."""
+    from deeplearning_cfn_tpu.cluster.broker_client import shard_for_key
+
+    return shard_for_key(key, n_shards)
+
+
+class ShardedSimBroker:
+    """N independent :class:`ReplicatedSimBroker` pairs behind the
+    production consistent-hash ring (``broker_client.shard_for_key``).
+
+    Queues/keys/workers route to ``shard_for_key(key, n_shards)``; each
+    shard fails over, fences, and auto-re-provisions independently, so a
+    single shard's outage stalls only the keys that hash there — the sim
+    twin of ``ensure_sharded_broker``'s per-shard pairs."""
+
+    def __init__(self, clock: VirtualClock, n_shards: int = 4):
+        if n_shards < 1:
+            raise ValueError(f"need at least one shard, got {n_shards}")
+        self.clock = clock
+        self.n_shards = n_shards
+        self.shards = [
+            ReplicatedSimBroker(
+                clock,
+                primary_name=f"shard{k}-a",
+                standby_name=f"shard{k}-b",
+            )
+            for k in range(n_shards)
+        ]
+
+    def shard_index(self, key: str) -> int:
+        return _shard_for_key(key, self.n_shards)
+
+    def route(self, key: str) -> ReplicatedSimBroker:
+        return self.shards[self.shard_index(key)]
+
+    def active_dump(self) -> dict[str, tuple[float, int]]:
+        """The merged heartbeat table a liveness watcher fetches: every
+        shard's live primary contributes its slice; a shard mid-failover
+        contributes nothing (only ITS workers go briefly unobserved)."""
+        merged: dict[str, tuple[float, int]] = {}
+        for shard in self.shards:
+            merged.update(shard.active_dump())
+        return merged
+
+    def stream_all(self) -> int:
+        """One replication pass over every shard whose recorded primary
+        is the acting one (a shard mid-failover is skipped, exactly as
+        ``ReplicationStreamer`` has no live source there)."""
+        shipped = 0
+        for shard in self.shards:
+            if shard.active() is shard.primary:
+                shipped += shard.stream()
+        return shipped
+
+    def healed_pairs(self) -> int:
+        """Shards whose pair is whole and caught up: a live primary, a
+        live replicating standby, zero replication lag."""
+        healed = 0
+        for shard in self.shards:
+            acting = shard.active()
+            if (
+                acting is not None
+                and acting is shard.primary
+                and shard.standby.up
+                and shard.standby.role == "standby"
+                and not shard.pending()
+            ):
+                healed += 1
+        return healed
+
+
+class ShardedSimConnection:
+    """Duck-types the agent-facing connection surface over a
+    :class:`ShardedSimBroker`: each op hashes its key to a shard and
+    walks THAT shard's endpoints through a per-shard
+    :class:`FailoverSimConnection` (``nodes_source`` re-reads the pair,
+    so an auto-re-provisioned standby is visible without a redial)."""
+
+    def __init__(self, cluster: ShardedSimBroker):
+        self._cluster = cluster
+        self._conns = [
+            FailoverSimConnection(nodes_source=shard.nodes)
+            for shard in cluster.shards
+        ]
+        self.closed = False
+
+    @property
+    def failovers(self) -> int:
+        return sum(conn.failovers for conn in self._conns)
+
+    def _conn_for(self, key: str) -> FailoverSimConnection:
+        if self.closed:
+            raise SimBrokerError("connection is closed")
+        return self._conns[self._cluster.shard_index(key)]
+
+    def heartbeat(self, worker_id: str) -> int:
+        return self._conn_for(worker_id).heartbeat(worker_id)
+
+    def telem(self, worker_id: str, snapshot: bytes) -> int:
+        return self._conn_for(worker_id).telem(worker_id, snapshot)
+
+    def send_idempotent(self, queue: str, body: bytes, rid: str) -> str:
+        return self._conn_for(queue).send_idempotent(queue, body, rid)
+
+    def close(self) -> None:
+        self.closed = True
+        for conn in self._conns:
+            conn.close()
+
+
+def soak_fleet(
+    agents: int = 10000,
+    shards: int = 8,
+    seed: int = 0,
+    kill_count: int = 200,
+    senders: int = 400,
+    failover_shards: int = 3,
+    unshipped_tail: int = 11,
+    stale_writes: int = 5,
+    tick_s: float = 5.0,
+    config: LivenessConfig | None = None,
+) -> dict:
+    """10,000-agent (by default) multi-shard fleet soak on virtual time.
+
+    The fleet-scale schedule the sharded control plane must survive, all
+    in one seeded run: real ``Heartbeater`` instances beat through
+    shard-routed failover connections; a real ``BrokerLivenessWatcher``
+    classifies silence from the MERGED per-shard heartbeat tables; a
+    seeded subset of agents dies silently.  Then, concurrently:
+    ``failover_shards`` primaries die mid-traffic with unshipped journal
+    tails (promotion + AUTO-RE-PROVISION of a fresh standby, half the
+    shards healing before the client re-send storm and half after — the
+    re-provision race); one healthy shard suffers a partition cut (its
+    standby is promoted while the deposed primary keeps accepting
+    writes, whose replication attempt must fence WITHOUT advancing the
+    new primary — reject, never diverge); every sender blindly re-sends
+    its request id through the shard router.  Traffic then drains until
+    every silent death is detected on the replicated tables.
+
+    Returns structural facts only (no wall-clock, no paths), so reports
+    are byte-deterministic per seed: the terminate counters and
+    ``duplicate_sends`` / ``diverged_entries`` must be 0 with
+    ``delivered == senders + stale_writes``, ``degraded_pairs`` must be
+    0 (no post-failover steady state missing a standby), and
+    ``unaffected_shard_failovers`` must be 0 (a one-shard outage stalls
+    only that shard's clients).
+    """
+    from deeplearning_cfn_tpu.cluster.broker_service import (
+        BrokerLivenessWatcher,
+    )
+    from deeplearning_cfn_tpu.obs.heartbeat import Heartbeater
+    from deeplearning_cfn_tpu.provision.events import EventBus, EventKind
+
+    rng = random.Random(seed)
+    clock = VirtualClock()
+    cluster = ShardedSimBroker(clock, n_shards=shards)
+    cfg = config or LivenessConfig()
+    bus = EventBus()
+    terminated: list[tuple[str, float | None]] = []
+
+    def on_event(event: Any) -> None:
+        if event.kind is EventKind.INSTANCE_TERMINATE:
+            shard = cluster.route(event.instance_id)
+            node = shard.active() or shard.standby
+            terminated.append(
+                (event.instance_id, node.silence_s(event.instance_id))
+            )
+
+    bus.subscribe(on_event)
+    watcher = BrokerLivenessWatcher(
+        cluster_name="sim-fleet",
+        group="agents",
+        bus=bus,
+        config=cfg,
+        clock=clock,
+        fetch=cluster.active_dump,
+    )
+
+    names = [f"agent-{i:05d}" for i in range(agents)]
+    killed = set(rng.sample(names, kill_count))
+    live = [w for w in names if w not in killed]
+    sender_names = rng.sample(live, senders)
+    # One failover connection per agent, pinned to ITS shard; tagged by
+    # shard so the blast radius of each outage is attributable.
+    agent_conns: list[tuple[int, FailoverSimConnection]] = []
+
+    def make_conn(worker: str) -> FailoverSimConnection:
+        k = cluster.shard_index(worker)
+        conn = FailoverSimConnection(nodes_source=cluster.shards[k].nodes)
+        agent_conns.append((k, conn))
+        return conn
+
+    beaters = {
+        w: Heartbeater(
+            host="sim",
+            port=0,
+            worker_id=w,
+            interval_s=tick_s,
+            connection_factory=lambda w=w: make_conn(w),
+        )
+        for w in names
+    }
+    alive = set(names)
+
+    def round_() -> None:
+        for w in names:
+            if w in alive:
+                beaters[w].beat_step()
+        cluster.stream_all()
+        clock.advance(tick_s)
+        watcher.poll()
+
+    # Warmup: everyone beating on every shard, replication caught up.
+    for _ in range(3):
+        round_()
+    # A seeded subset dies silently, mid-traffic.
+    alive -= killed
+    for _ in range(2):
+        round_()
+
+    # The kill round: beats + shard-routed idempotent submissions land,
+    # then a seeded subset of shard PRIMARIES dies with their journal
+    # tails unshipped.
+    for w in names:
+        if w in alive:
+            beaters[w].beat_step()
+    queues = {w: f"work/{w}" for w in sender_names}
+    rids = {w: f"{w}/job-{seed}" for w in sender_names}
+    for w in sender_names:
+        cluster.route(queues[w]).primary.send_idempotent(
+            queues[w], f"payload-{w}".encode(), rids[w]
+        )
+    fail_shards = sorted(rng.sample(range(shards), failover_shards))
+    unshipped_total = 0
+    for k in range(shards):
+        shard = cluster.shards[k]
+        if k in fail_shards:
+            backlog = len(shard.pending())
+            shard.stream(max_entries=max(0, backlog - unshipped_tail))
+            unshipped_total += len(shard.pending())
+            shard.kill_primary()
+        else:
+            shard.stream()
+    clock.advance(tick_s)
+    watcher.poll()  # dead shards fetch empty: nobody terminates early
+
+    # Promotion + auto-heal wave.  Even-indexed shards re-provision their
+    # fresh standby BEFORE the client re-send storm, odd-indexed after —
+    # both orders of the re-provision race run every seed.
+    epochs: dict[str, int] = {}
+    for idx, k in enumerate(fail_shards):
+        shard = cluster.shards[k]
+        epochs[str(k)] = shard.promote_standby()
+        if idx % 2 == 0:
+            shard.reprovision_standby()
+
+    # Partition cut on the lowest HEALTHY shard: its standby is promoted
+    # while the deposed primary is still up and accepting writes on its
+    # side of the cut.
+    split_shard = min(k for k in range(shards) if k not in fail_shards)
+    sp = cluster.shards[split_shard]
+    epochs[str(split_shard)] = sp.promote_standby()
+    split_queue = next(
+        q
+        for q in (f"split/{i}" for i in range(10 * shards))
+        if cluster.shard_index(q) == split_shard
+    )
+    stale_rids = [f"stale/{j}/job-{seed}" for j in range(stale_writes)]
+    for rid in stale_rids:
+        sp.primary.send_idempotent(split_queue, rid.encode(), rid)
+    # The deposed primary's replication attempt must be REJECTED without
+    # the new primary applying a single entry: fence, never diverge.
+    seq_before = sp.standby.sync_seq
+    fenced_streams = 0
+    try:
+        sp.stream(src=sp.primary, dst=sp.standby)
+    except SimFenced:
+        fenced_streams += 1
+    diverged_entries = (sp.standby.sync_seq - seq_before) + sum(
+        1
+        for rid in stale_rids
+        if rid in sp.standby.applied.get(split_queue, set())
+    )
+    # Heal the cut: the fenced ex-primary stands down and dies; the
+    # acting primary auto-re-provisions a fresh standby from its journal.
+    sp.demote(sp.primary)
+    sp.primary.up = False
+    sp.reprovision_standby()
+
+    # At-least-once across every switch: senders blindly re-send their
+    # request ids through the shard router, and the partition-era writes
+    # (lost with the deposed primary) are re-driven the same way.
+    resend = ShardedSimConnection(cluster)
+    for w in sender_names:
+        resend.send_idempotent(queues[w], f"payload-{w}".encode(), rids[w])
+    for rid in stale_rids:
+        resend.send_idempotent(split_queue, rid.encode(), rid)
+    resend.close()
+    for idx, k in enumerate(fail_shards):
+        if idx % 2 == 1:
+            cluster.shards[k].reprovision_standby()
+
+    # Drain: silence of the killed agents crosses dead_after_s on the
+    # replicated per-shard tables; continuous streaming keeps every
+    # fresh standby caught up.
+    drain_rounds = int(cfg.dead_after_s // tick_s) + 3
+    for _ in range(drain_rounds):
+        round_()
+
+    delivered = 0
+    rid_dupes = 0
+    for shard in cluster.shards:
+        acting = shard.active()
+        if acting is None:
+            continue
+        for entries in acting.queues.values():
+            rid_list = [rid for rid, _body in entries]
+            delivered += len(rid_list)
+            rid_dupes += len(rid_list) - len(set(rid_list))
+    affected = set(fail_shards) | {split_shard}
+    term_names = [w for w, _s in terminated]
+    return {
+        "agents": agents,
+        "shards": shards,
+        "killed": len(killed),
+        "terminated": len(term_names),
+        "lost_terminates": len(killed - set(term_names)),
+        "spurious_terminates": len(set(term_names) - killed),
+        "duplicate_terminates": len(term_names) - len(set(term_names)),
+        "premature_terminates": sum(
+            1 for _w, s in terminated if s is None or s < cfg.dead_after_s
+        ),
+        "senders": senders,
+        "sender_shards": len(
+            {cluster.shard_index(q) for q in queues.values()}
+        ),
+        "delivered": delivered,
+        "duplicate_sends": rid_dupes,
+        "failover_shards": [str(k) for k in fail_shards],
+        "split_shard": split_shard,
+        "epochs": epochs,
+        "unshipped_at_kill": unshipped_total,
+        "stale_writes": stale_writes,
+        "fenced_writes": sum(
+            n.fenced for sh in cluster.shards for n in sh.nodes()
+        ),
+        "fenced_streams": fenced_streams,
+        "diverged_entries": diverged_entries,
+        "reprovisions": sum(sh.reprovisions for sh in cluster.shards),
+        "healed_pairs": cluster.healed_pairs(),
+        "degraded_pairs": shards - cluster.healed_pairs(),
+        "client_failovers": sum(c.failovers for _k, c in agent_conns)
+        + resend.failovers,
+        "unaffected_shard_failovers": sum(
+            c.failovers for k, c in agent_conns if k not in affected
+        ),
         "rounds": 6 + drain_rounds,
     }

@@ -1873,6 +1873,175 @@ def split_brain(seed: int) -> ScenarioReport:
     return report
 
 
+# --- shard-failover ----------------------------------------------------------
+
+
+def shard_failover(seed: int) -> ScenarioReport:
+    """One shard's primary dies mid-traffic in a sharded fleet; the other
+    shards never notice, and the failed pair auto-heals back to a
+    replicating primary+standby.
+
+    Runs :func:`soak_fleet` — real Heartbeaters and per-shard
+    BrokerLivenessWatchers over a consistent-hash-sharded sim fleet on
+    virtual time — and pins the sharded acceptance invariants on top of
+    the single-pair ones: a failover on one shard stalls ONLY that
+    shard's clients (zero failovers on connections routed elsewhere),
+    every pair ends the run healed (a degraded pair is never steady
+    state), and the concurrent split-brain on another shard is fenced
+    without a single diverged entry.
+    """
+    from deeplearning_cfn_tpu.analysis.schedules import soak_fleet
+
+    report = ScenarioReport("shard-failover", seed)
+    soak = soak_fleet(
+        agents=2000,
+        shards=4,
+        seed=seed,
+        kill_count=50,
+        senders=100,
+        failover_shards=1,
+        unshipped_tail=5,
+        stale_writes=3,
+    )
+    report.check(
+        soak["terminated"] == soak["killed"]
+        and soak["lost_terminates"] == 0
+        and soak["spurious_terminates"] == 0
+        and soak["duplicate_terminates"] == 0
+        and soak["premature_terminates"] == 0,
+        f"exactly-once liveness verdicts across the shard failover "
+        f"({soak['killed']} killed agents, {soak['agents']} total)",
+    )
+    report.check(
+        soak["delivered"] == soak["senders"] + soak["stale_writes"]
+        and soak["duplicate_sends"] == 0,
+        "idempotent re-sends across the shard switch: every request id "
+        "landed exactly once on its shard's acting primary",
+    )
+    report.check(
+        soak["unaffected_shard_failovers"] == 0,
+        "a single-shard failover stalled only that shard: clients routed "
+        "to healthy shards never failed over",
+    )
+    report.check(
+        all(epoch == 1 for epoch in soak["epochs"].values())
+        and soak["unshipped_at_kill"] > 0,
+        "each failed shard promoted to a strictly-higher epoch with a "
+        "real unshipped journal tail at the kill",
+    )
+    report.check(
+        soak["degraded_pairs"] == 0
+        and soak["healed_pairs"] == soak["shards"]
+        and soak["reprovisions"] == len(soak["failover_shards"]) + 1,
+        "auto-heal restored a replicating primary+standby pair on every "
+        "shard (no degraded pair as steady state)",
+    )
+    report.check(
+        soak["diverged_entries"] == 0 and soak["fenced_streams"] == 1,
+        "the concurrent split-brain shard fenced its deposed primary's "
+        "stream; zero entries diverged",
+    )
+    report.details.update(soak)
+    return report
+
+
+# --- degraded-pair-heal ------------------------------------------------------
+
+
+def degraded_pair_heal(seed: int) -> ScenarioReport:
+    """A promoted standby must not stay alone: after a failover the new
+    primary re-provisions a fresh standby and replication lag drains to
+    zero — the self-healing half of the broker failover ladder.
+
+    Drives one replicated sim pair through kill -> promote ->
+    re-provision and pins that the replay of the promoted journal into
+    the fresh standby (old-term entries shipped under the new term) is
+    never fenced, converges to zero pending entries, and that
+    replication of NEW writes resumes on the healed pair."""
+    import random as _random
+
+    from deeplearning_cfn_tpu.analysis.schedules import (
+        ReplicatedSimBroker,
+        VirtualClock,
+    )
+
+    report = ScenarioReport("degraded-pair-heal", seed)
+    rng = _random.Random(seed)
+    clock = VirtualClock()
+    cluster = ReplicatedSimBroker(clock)
+
+    # Replicated traffic, then a tail the standby never saw.
+    pre = 30 + rng.randrange(10)
+    tail = 3 + rng.randrange(4)
+    for i in range(pre + tail):
+        cluster.primary.send_idempotent("work", f"r-{i}".encode(), f"r-{i}")
+        clock.advance(0.5)
+    cluster.stream(max_entries=pre)
+    cluster.kill_primary()
+    epoch = cluster.promote_standby()
+    acting = cluster.active()
+    report.check(
+        epoch == 1
+        and acting is cluster.standby
+        and acting.sync_seq == pre,
+        "standby promoted at a strictly-higher epoch holding exactly the "
+        f"shipped prefix ({pre} of {pre + tail} writes)",
+    )
+
+    # The degraded window is real: the promoted node is alone.
+    report.check(
+        cluster.primary is not acting or cluster.standby is acting,
+        "pair is degraded after promotion (promoted node has no standby)",
+    )
+
+    # Auto-heal: fresh standby at the promoted epoch, full journal replay.
+    fresh = cluster.reprovision_standby()
+    report.check(
+        cluster.primary is acting
+        and cluster.standby is fresh
+        and fresh.role == "standby"
+        and fresh.epoch == epoch,
+        "re-provisioned standby joined at the promoted epoch",
+    )
+    report.check(
+        fresh.fenced == 0,
+        "replaying old-term journal entries under the new term was never "
+        "fenced (sender-epoch stamping)",
+    )
+    report.check(
+        len(cluster.pending()) == 0 and fresh.sync_seq == acting.seq,
+        "replication lag drained to zero within the scenario",
+    )
+    healed_rids = {rid for rid, _body in fresh.queues.get("work", [])}
+    report.check(
+        healed_rids == {f"r-{i}" for i in range(pre)},
+        "fresh standby state matches the acting primary's exactly "
+        "(the dead node's unshipped tail is gone from both)",
+    )
+
+    # The healed pair replicates new writes like any healthy pair.
+    post = 5 + rng.randrange(5)
+    for i in range(post):
+        acting.send_idempotent("work", f"post-{i}".encode(), f"post-{i}")
+        clock.advance(0.5)
+    shipped = cluster.stream()
+    report.check(
+        shipped == post
+        and fresh.sync_seq == acting.seq
+        and fresh.fenced == 0,
+        "replication of new writes resumed on the healed pair",
+    )
+    report.details.update(
+        pre_writes=pre,
+        unshipped_tail=tail,
+        post_writes=post,
+        epoch=epoch,
+        reprovisions=cluster.reprovisions,
+        standby_seq=fresh.sync_seq,
+    )
+    return report
+
+
 # --- alert-storm -------------------------------------------------------------
 
 
@@ -2171,6 +2340,8 @@ SCENARIOS: dict[str, Callable[[int], ScenarioReport]] = {
     "serve-replica-loss": serve_replica_loss,
     "broker-failover": broker_failover,
     "split-brain": split_brain,
+    "shard-failover": shard_failover,
+    "degraded-pair-heal": degraded_pair_heal,
     "alert-storm": alert_storm,
 }
 

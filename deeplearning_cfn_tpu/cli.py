@@ -581,16 +581,22 @@ def _status_broker_role(args) -> dict | None:
     """Control-plane role / epoch / replication lag, or None.
 
     ``--cluster`` reads the recorded replicated pair (primary plus warm
-    standby, with lag in entries and seconds); ``--broker HOST:PORT``
-    asks the dialed node directly via the ROLE verb.  A cluster with no
-    recorded broker, or a dial failure, yields None — status stays
+    standby, with lag in entries and seconds) — or, when a shard map is
+    recorded (ensure_sharded_broker), the per-shard replication table
+    with a degraded flag per pair.  ``--broker HOST:PORT`` asks the
+    dialed node directly via the ROLE and SHARD verbs.  A cluster with
+    no recorded broker, or a dial failure, yields None — status stays
     usable against legacy single-process brokers."""
     if args.cluster:
         from deeplearning_cfn_tpu.cluster.broker_service import (
             broker_replication_status,
+            broker_shard_replication_status,
             broker_status,
         )
 
+        sharded = broker_shard_replication_status(args.cluster)
+        if sharded is not None:
+            return sharded
         if broker_status(args.cluster) is None:
             return None
         return broker_replication_status(args.cluster)
@@ -605,19 +611,24 @@ def _status_broker_role(args) -> dict | None:
             conn = BrokerConnection(host, port)
             try:
                 role_name, epoch, seq = conn.role()
+                shard, n_shards = conn.shard()
             finally:
                 conn.close()
         except (OSError, BrokerError):
             return None
+        primary = {
+            "host": host,
+            "port": port,
+            "alive": True,
+            "role": role_name,
+            "epoch": epoch,
+            "seq": seq,
+        }
+        if n_shards > 1:
+            primary["shard"] = shard
+            primary["n_shards"] = n_shards
         return {
-            "primary": {
-                "host": host,
-                "port": port,
-                "alive": True,
-                "role": role_name,
-                "epoch": epoch,
-                "seq": seq,
-            },
+            "primary": primary,
             "standby": None,
             "lag_entries": None,
             "lag_seconds": None,
@@ -728,6 +739,20 @@ def _status_reshard(args) -> dict | None:
     from deeplearning_cfn_tpu.obs.recorder import read_journal
 
     return fold_reshard_events(read_journal(args.journal)) or None
+
+
+def _status_broker_events(args) -> dict | None:
+    """Broker lifecycle counters folded from journaled
+    ``broker_promoted`` / ``standby_reprovisioned`` events, or None (no
+    journal / no failovers).  Merged into the ``broker`` status block so
+    an operator sees promotion and self-heal counts next to the live
+    replication table."""
+    if not args.journal:
+        return None
+    from deeplearning_cfn_tpu.obs.exporter import fold_broker_events
+    from deeplearning_cfn_tpu.obs.recorder import read_journal
+
+    return fold_broker_events(read_journal(args.journal)) or None
 
 
 def _status_serve(args) -> dict | None:
@@ -898,6 +923,9 @@ def cmd_status(args) -> int:
         )
     liveness = _status_liveness(args)
     broker = _status_broker_role(args)
+    broker_events = _status_broker_events(args)
+    if broker_events is not None:
+        broker = {**(broker or {}), "events": broker_events}
     spans = _status_spans(args)
     pipeline = _status_pipeline(args)
     reshard = _status_reshard(args)

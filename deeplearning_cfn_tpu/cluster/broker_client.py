@@ -16,6 +16,7 @@ import shutil
 import socket
 import subprocess
 import uuid
+import zlib
 from pathlib import Path
 from typing import Any
 
@@ -53,6 +54,19 @@ def _traced(method):
 
 BROKER_DIR = Path(__file__).resolve().parents[2] / "native" / "broker"
 BROKER_BIN = BROKER_DIR / "dlcfn-broker"
+
+
+def shard_for_key(key: str, n_shards: int) -> int:
+    """The broker keyspace hash ring: which shard owns ``key``.
+
+    CRC32 rather than Python's ``hash()`` — the ring must be stable
+    across processes, restarts, and languages (PYTHONHASHSEED randomizes
+    ``hash()`` per interpreter), because the router, the sim fleet, and
+    any future C++ client must all agree on placement.  Queues, KV keys,
+    and heartbeat worker ids all route through this one function."""
+    if n_shards < 1:
+        raise ValueError(f"need at least one shard, got {n_shards}")
+    return zlib.crc32(key.encode("utf-8")) % n_shards
 
 
 class BrokerError(RuntimeError):
@@ -376,6 +390,18 @@ class BrokerConnection:
             raise BrokerError(f"SYNC failed: {resp}")
         return int(resp[3:])
 
+    @_traced
+    def shard(self) -> tuple[int, int]:
+        """The peer's (shard index, total shards) on the keyspace ring;
+        (0, 1) for an unsharded broker.  Lets a router verify it dialed
+        the owner of the keys it is about to route."""
+        self.sock.sendall(b"SHARD\n")
+        sline = self._read_line().split(" ")
+        if sline[0] != "SHARD" or len(sline) != 3:
+            raise BrokerError(f"bad SHARD frame: {sline}")
+        _, shard, n_shards = sline
+        return int(shard), int(n_shards)
+
 
 def endpoints_from_record(record: dict) -> list[tuple[str, int]]:
     """The failover endpoint list a broker record file publishes.
@@ -415,6 +441,13 @@ class FailoverBrokerConnection:
     ``dial(host, port)`` is the connection seam: tests and the
     virtual-clock soak inject simulated connections; the default dials a
     real :class:`BrokerConnection` with this instance's token.
+
+    ``endpoints_source`` (optional, ``() -> [(host, port), ...]``) is
+    re-read once per RPC after every construction-time endpoint has been
+    refused: after a failover the adoption ladder REWRITES the broker
+    record (promoted primary first, auto-re-provisioned standby after),
+    so a client started before the failover finds the fresh pair without
+    a restart instead of walking dead endpoints forever.
     """
 
     _ENDPOINT_ERROR_HINTS = ("closed connection", "not primary")
@@ -428,6 +461,7 @@ class FailoverBrokerConnection:
         clock: Clock | None = None,
         max_cycles: int = 2,
         timeout_s: float = 10.0,
+        endpoints_source=None,
     ):
         if not endpoints:
             raise BrokerError("failover connection needs at least one endpoint")
@@ -453,12 +487,15 @@ class FailoverBrokerConnection:
                     clock=self._clock,
                 )
 
+        self._breaker_factory = breaker_factory
         self._breakers = {ep: breaker_factory(*ep) for ep in self._endpoints}
+        self._endpoints_source = endpoints_source
         self._conn = None
         self._active = 0
         self._established: tuple[str, int] | None = None
         self._max_cycles = max_cycles
         self.failovers = 0
+        self.endpoint_refreshes = 0
 
     @property
     def active_endpoint(self) -> tuple[str, int]:
@@ -489,46 +526,82 @@ class FailoverBrokerConnection:
                 return idx
         return None
 
-    def _call(self, rpc: str, op):
-        attempts = len(self._endpoints) * self._max_cycles
-        last: BaseException | None = None
-        for _ in range(attempts):
-            idx = self._next_allowed()
-            if idx is None:
-                break
-            endpoint = self._endpoints[idx]
-            try:
-                if self._conn is None or idx != self._active:
-                    self.close()
-                    self._conn = self._dial(*endpoint)
-                    self._active = idx
-                result = op(self._conn)
-            except BaseException as exc:
-                if not self._is_endpoint_failure(exc):
-                    raise
-                last = exc
-                self._breakers[endpoint].record_failure()
-                self.close()
-                self._active = (idx + 1) % len(self._endpoints)
-                continue
-            if self._established is not None and endpoint != self._established:
-                # A successful switch is a failover, not an outage: reset
-                # the adopted endpoint's breaker and journal the event
-                # instead of feeding any shared failure budget.
-                self.failovers += 1
-                from deeplearning_cfn_tpu.obs.recorder import get_recorder
+    def _refresh_endpoints(self) -> bool:
+        """Re-read the endpoint list from ``endpoints_source`` (the
+        rewritten broker record after adoption/re-provisioning).  Returns
+        whether the list actually changed; breakers for surviving
+        endpoints keep their failure history, new endpoints start
+        closed."""
+        if self._endpoints_source is None:
+            return False
+        try:
+            fresh = [
+                (str(h), int(p)) for h, p in (self._endpoints_source() or [])
+            ]
+        except Exception as exc:
+            log.warning("broker endpoint refresh failed: %s", exc)
+            return False
+        if not fresh or fresh == self._endpoints:
+            return False
+        self.close()
+        self._breakers = {
+            ep: self._breakers.get(ep) or self._breaker_factory(*ep)
+            for ep in fresh
+        }
+        self._endpoints = fresh
+        self._active = 0
+        self.endpoint_refreshes += 1
+        return True
 
-                get_recorder().record(
-                    "broker_failover",
-                    rpc=rpc,
-                    from_host=self._established[0],
-                    from_port=self._established[1],
-                    to_host=endpoint[0],
-                    to_port=endpoint[1],
-                )
-            self._breakers[endpoint].record_success()
-            self._established = endpoint
-            return result
+    def _call(self, rpc: str, op):
+        last: BaseException | None = None
+        # Second pass only after a refresh actually changed the endpoint
+        # list: every known endpoint was refused, so re-read the record —
+        # adoption may have replaced the pair since this client started.
+        for attempt_pass in range(2):
+            if attempt_pass and not self._refresh_endpoints():
+                break
+            attempts = len(self._endpoints) * self._max_cycles
+            for _ in range(attempts):
+                idx = self._next_allowed()
+                if idx is None:
+                    break
+                endpoint = self._endpoints[idx]
+                try:
+                    if self._conn is None or idx != self._active:
+                        self.close()
+                        self._conn = self._dial(*endpoint)
+                        self._active = idx
+                    result = op(self._conn)
+                except BaseException as exc:
+                    if not self._is_endpoint_failure(exc):
+                        raise
+                    last = exc
+                    self._breakers[endpoint].record_failure()
+                    self.close()
+                    self._active = (idx + 1) % len(self._endpoints)
+                    continue
+                if (
+                    self._established is not None
+                    and endpoint != self._established
+                ):
+                    # A successful switch is a failover, not an outage:
+                    # reset the adopted endpoint's breaker and journal the
+                    # event instead of feeding any shared failure budget.
+                    self.failovers += 1
+                    from deeplearning_cfn_tpu.obs.recorder import get_recorder
+
+                    get_recorder().record(
+                        "broker_failover",
+                        rpc=rpc,
+                        from_host=self._established[0],
+                        from_port=self._established[1],
+                        to_host=endpoint[0],
+                        to_port=endpoint[1],
+                    )
+                self._breakers[endpoint].record_success()
+                self._established = endpoint
+                return result
         raise BrokerError(
             f"{rpc}: no broker endpoint available (endpoints "
             f"{self._endpoints}, last error: {last})"
@@ -584,6 +657,176 @@ class FailoverBrokerConnection:
 
     def role(self) -> tuple[str, int, int]:
         return self._call("role", lambda c: c.role())
+
+    def shard(self) -> tuple[int, int]:
+        return self._call("shard", lambda c: c.shard())
+
+
+class ShardedBrokerRouter:
+    """Shard-aware broker client over N independent primary/standby pairs.
+
+    Hashes every queue/key/worker id on the production ring
+    (:func:`shard_for_key`) and drives THAT shard's
+    :class:`FailoverBrokerConnection` — per-endpoint CircuitBreakers,
+    idempotent SENDID re-sends, and record-refresh failover all stay
+    endpoint-local, so a single shard's failover stalls only the keys
+    that hash there while the other shards' traffic flows untouched.
+
+    ``shard_endpoints`` is a list (index = shard) of endpoint lists;
+    ``shard_endpoint_sources`` optionally supplies a per-shard
+    ``endpoints_source`` callable (normally a closure over that shard's
+    record file) so long-lived routers survive adoption rewrites.
+    Table-dump reads (``heartbeats``/``telemetry``) merge every
+    reachable shard and skip shards mid-failover — the merged-view
+    contract the liveness watcher expects."""
+
+    def __init__(
+        self,
+        shard_endpoints,
+        token: str | None = None,
+        dial=None,
+        breaker_factory=None,
+        clock: Clock | None = None,
+        timeout_s: float = 10.0,
+        shard_endpoint_sources=None,
+    ):
+        if not shard_endpoints:
+            raise BrokerError("sharded router needs at least one shard")
+        if shard_endpoint_sources is not None and len(
+            shard_endpoint_sources
+        ) != len(shard_endpoints):
+            raise BrokerError(
+                "shard_endpoint_sources must match shard_endpoints"
+            )
+        self.n_shards = len(shard_endpoints)
+        self._conns = [
+            FailoverBrokerConnection(
+                endpoints,
+                token=token,
+                dial=dial,
+                breaker_factory=breaker_factory,
+                clock=clock,
+                timeout_s=timeout_s,
+                endpoints_source=(
+                    shard_endpoint_sources[k]
+                    if shard_endpoint_sources is not None
+                    else None
+                ),
+            )
+            for k, endpoints in enumerate(shard_endpoints)
+        ]
+
+    @classmethod
+    def for_cluster(
+        cls, cluster_name: str, root=None, **kwargs
+    ) -> "ShardedBrokerRouter":
+        """Build a router from a recorded sharded deployment: per-shard
+        endpoints come from each shard's record file, and each shard's
+        ``endpoints_source`` re-reads that record so adoption rewrites
+        are picked up live."""
+        from deeplearning_cfn_tpu.cluster import broker_service
+
+        shard_map = broker_service.sharded_broker_records(cluster_name, root)
+        if shard_map is None:
+            raise BrokerError(
+                f"no sharded broker recorded for {cluster_name}"
+            )
+        endpoints: list[list[tuple[str, int]]] = []
+        sources = []
+        token = None
+        for entry in shard_map:
+            record = entry.get("record")
+            if record is None:
+                raise BrokerError(
+                    f"shard {entry.get('shard')} of {cluster_name} has no "
+                    "live record"
+                )
+            token = token or record.get("token")
+            endpoints.append(endpoints_from_record(record))
+
+            def source(name=entry["cluster"]):
+                rec = broker_service.broker_status(name, root)
+                return endpoints_from_record(rec) if rec else []
+
+            sources.append(source)
+        kwargs.setdefault("token", token)
+        return cls(endpoints, shard_endpoint_sources=sources, **kwargs)
+
+    @property
+    def failovers(self) -> int:
+        return sum(conn.failovers for conn in self._conns)
+
+    def shard_index(self, key: str) -> int:
+        return shard_for_key(key, self.n_shards)
+
+    def connection(self, key: str) -> FailoverBrokerConnection:
+        """The failover connection owning ``key``'s shard."""
+        return self._conns[self.shard_index(key)]
+
+    def shard_connections(self) -> list[FailoverBrokerConnection]:
+        return list(self._conns)
+
+    def close(self) -> None:
+        for conn in self._conns:
+            conn.close()
+
+    # -- key-routed verbs -------------------------------------------------
+    def ping(self) -> bool:
+        return all(conn.ping() for conn in self._conns)
+
+    def send(self, queue: str, body: bytes, rid: str | None = None) -> str:
+        return self.connection(queue).send(queue, body, rid)
+
+    def send_idempotent(self, queue: str, body: bytes, rid: str) -> str:
+        return self.connection(queue).send_idempotent(queue, body, rid)
+
+    def receive(self, queue: str, max_messages: int, visibility_ms: int):
+        return self.connection(queue).receive(
+            queue, max_messages, visibility_ms
+        )
+
+    def delete(self, queue: str, receipt: str) -> bool:
+        return self.connection(queue).delete(queue, receipt)
+
+    def depth(self, queue: str) -> int:
+        return self.connection(queue).depth(queue)
+
+    def purge(self, queue: str) -> None:
+        return self.connection(queue).purge(queue)
+
+    def set(self, key: str, value: bytes) -> None:
+        return self.connection(key).set(key, value)
+
+    def get(self, key: str) -> bytes | None:
+        return self.connection(key).get(key)
+
+    def unset(self, key: str) -> bool:
+        return self.connection(key).unset(key)
+
+    def heartbeat(self, worker_id: str) -> int:
+        return self.connection(worker_id).heartbeat(worker_id)
+
+    def telem(self, worker_id: str, snapshot: bytes) -> int:
+        return self.connection(worker_id).telem(worker_id, snapshot)
+
+    # -- merged table dumps ----------------------------------------------
+    def heartbeats(self) -> dict[str, tuple[float, int]]:
+        merged: dict[str, tuple[float, int]] = {}
+        for conn in self._conns:
+            try:
+                merged.update(conn.heartbeats())
+            except BrokerError:
+                continue  # shard mid-failover: only ITS slice goes dark
+        return merged
+
+    def telemetry(self) -> dict[str, tuple[float, int, bytes]]:
+        merged: dict[str, tuple[float, int, bytes]] = {}
+        for conn in self._conns:
+            try:
+                merged.update(conn.telemetry())
+            except BrokerError:
+                continue
+        return merged
 
 
 class BrokerQueue(RendezvousQueue):

@@ -79,6 +79,32 @@ def _repl_log_path(cluster_name: str, root: Path | None = None) -> Path:
     return root / "broker" / f"{cluster_name}.repl.jsonl"
 
 
+def _standby_repl_log_path(
+    cluster_name: str, root: Path | None = None
+) -> Path:
+    """The STANDBY's copy of the journal: every SYNC entry it applies is
+    re-journaled at the entry's own seq/epoch, so after a promotion the
+    adopter renames this file over :func:`_repl_log_path` and replication
+    resumes from the promoted node's journal (self-healing pair)."""
+    root = root or ClusterContract.root_dir()
+    return root / "broker" / f"{cluster_name}.standby.repl.jsonl"
+
+
+def shard_cluster_name(cluster_name: str, shard: int) -> str:
+    """The per-shard internal cluster name: shard ``k`` of ``cluster`` is
+    recorded, locked, logged, and journaled as ``cluster.shard<k>`` —
+    every single-pair code path (spawn/adopt/teardown/status) applies to
+    a shard unchanged."""
+    return f"{cluster_name}.shard{shard}"
+
+
+def _shard_map_path(cluster_name: str, root: Path | None = None) -> Path:
+    """The shard-map record: which per-shard cluster names make up a
+    sharded deployment, in ring order."""
+    root = root or ClusterContract.root_dir()
+    return root / "broker" / f"{cluster_name}.shards.json"
+
+
 def detect_host_ip() -> str:
     """This host's outbound IP — the address a TPU VM would dial.  The
     UDP-connect trick never sends a packet; the fallback is loopback
@@ -198,6 +224,15 @@ def _adopt_standby(
     ``(host, port, False)`` like a reuse, or None when no LIVE standby
     exists; a stale standby record is unlinked here so it cannot shadow
     the dead primary (the single-process-singleton bug this replaces).
+
+    Self-healing (docs/RESILIENCE.md "Sharded broker"): the promoted
+    node's own journal copy is renamed over the primary journal path
+    (its repl fd follows the inode, so post-promotion appends continue
+    in place), a FRESH standby is re-provisioned, and the journal is
+    replayed into it — a failover never leaves a degraded pair as steady
+    state.  Re-provisioning is best-effort: a failure degrades to the
+    pre-heal behavior (promoted primary, no standby) rather than failing
+    the adoption.
     """
     srec = _standby_record_path(cluster_name, root)
     try:
@@ -225,25 +260,37 @@ def _adopt_standby(
         conn.close()
     host = standby.get("host") or dead_record.get("host") or "127.0.0.1"
     port = int(standby["port"])
-    _write_record(
-        rec,
-        {
-            "cluster": cluster_name,
-            "host": host,
-            "port": port,
-            "pid": int(standby["pid"]),
-            "binds": standby.get("binds", dead_record.get("binds", "")),
-            "binds_requested": standby.get(
-                "binds_requested", dead_record.get("binds_requested", "")
-            ),
-            "token": token or None,
-            "role": "primary",
-            "epoch": new_epoch,
-            "endpoints": [[host, port]],
-            "started_ts": standby.get("started_ts", time.time()),
-        },
-    )
+    record_payload = {
+        "cluster": cluster_name,
+        "host": host,
+        "port": port,
+        "pid": int(standby["pid"]),
+        "binds": standby.get("binds", dead_record.get("binds", "")),
+        "binds_requested": standby.get(
+            "binds_requested", dead_record.get("binds_requested", "")
+        ),
+        "token": token or None,
+        "role": "primary",
+        "epoch": new_epoch,
+        "endpoints": [[host, port]],
+        "started_ts": standby.get("started_ts", time.time()),
+    }
+    for key in ("shard", "n_shards"):
+        if key in dead_record:
+            record_payload[key] = dead_record[key]
+    _write_record(rec, record_payload)
     srec.unlink(missing_ok=True)
+    # The promoted node journaled every entry it acked into its own copy;
+    # rename it over the primary journal path so its repl fd (which
+    # follows the inode) keeps appending there and the streamer resumes
+    # from the promoted node's journal.  The dead primary's journal — and
+    # with it any unshipped tail that died with the process — is replaced.
+    standby_repl = _standby_repl_log_path(cluster_name, root)
+    repl_log = _repl_log_path(cluster_name, root)
+    if standby_repl.exists():
+        os.replace(standby_repl, repl_log)
+    else:
+        repl_log.unlink(missing_ok=True)
     log.warning(
         "promoted standby broker for %s at %s:%d (pid %s, epoch %d, "
         "replayed seq %d)",
@@ -257,6 +304,33 @@ def _adopt_standby(
         epoch=new_epoch,
         repl_seq=repl_seq,
     )
+    # Self-heal: re-provision a FRESH standby and replay the journal into
+    # it, so broker_replication_status never reports a degraded pair as
+    # steady state.  Best-effort — the promoted primary is already
+    # serving; a heal failure is logged and retried by the next ensure.
+    try:
+        sb_host, sb_port, _ = ensure_standby_broker(cluster_name, root=root)
+        streamer = ReplicationStreamer(cluster_name, root=root)
+        replayed = streamer.step()
+        get_recorder().record(
+            "standby_reprovisioned",
+            cluster=cluster_name,
+            broker_host=sb_host,
+            broker_port=sb_port,
+            epoch=new_epoch,
+            replayed=replayed,
+        )
+        log.warning(
+            "re-provisioned standby broker for %s at %s:%d (%d journal "
+            "entries replayed)",
+            cluster_name, sb_host, sb_port, replayed,
+        )
+    except (OSError, BrokerError) as exc:
+        log.warning(
+            "standby re-provision for %s failed (pair stays degraded "
+            "until the next ensure): %s",
+            cluster_name, exc,
+        )
     return host, port, False
 
 
@@ -269,6 +343,8 @@ def ensure_broker(
     extra_binds: Sequence[str] | None = None,
     reuse_token: str | None = None,
     reuse_epoch: int | None = None,
+    shard: int | None = None,
+    n_shards: int | None = None,
 ) -> tuple[str, int, bool]:
     """Return ``(host, port, started)`` for a live broker serving this
     cluster, starting one (detached) if none is recorded and reachable.
@@ -278,7 +354,11 @@ def ensure_broker(
     requested binds here so the replacement serves the union.  Without
     the union, two concurrent CLIs passing different advertise addresses
     would ping-pong: each restart binds only its own advertise, which
-    re-fails the other CLI's reuse check, which restarts again."""
+    re-fails the other CLI's reuse check, which restarts again.
+
+    ``shard``/``n_shards``: the keyspace-ring stamp for a per-shard pair
+    spawned by :func:`ensure_sharded_broker` — written to the record and
+    the binary's SHARD identity; None for an unsharded broker."""
     rec = _record_path(cluster_name, root)
 
     def reuse_live(record: dict) -> tuple[str, int, bool] | None:
@@ -352,6 +432,7 @@ def ensure_broker(
         return ensure_broker(
             cluster_name, root=root, advertise=advertise, port=port,
             timeout_s=timeout_s, extra_binds=merged,
+            shard=shard, n_shards=n_shards,
             # Carry the old broker's AUTH token into the replacement:
             # agents provisioned by the OTHER CLI hold it in VM metadata,
             # and that CLI's process holds it ambiently — regenerating
@@ -469,7 +550,7 @@ def ensure_broker(
                     cluster_name, root=root, advertise=advertise, port=port,
                     timeout_s=max(deadline - time.monotonic(), 5.0),
                     extra_binds=extra_binds, reuse_token=reuse_token,
-                    reuse_epoch=reuse_epoch,
+                    reuse_epoch=reuse_epoch, shard=shard, n_shards=n_shards,
                 )
             time.sleep(0.1)
         raise BrokerError(
@@ -504,18 +585,22 @@ def ensure_broker(
             # new term's stream.
             repl_log = _repl_log_path(cluster_name, root)
             repl_log.unlink(missing_ok=True)
+            spawn_env = {
+                **os.environ,
+                "DLCFN_BROKER_TOKEN": token,
+                "DLCFN_BROKER_ROLE": "primary",
+                "DLCFN_BROKER_EPOCH": str(epoch),
+                "DLCFN_BROKER_REPL_LOG": str(repl_log),
+            }
+            if n_shards is not None:
+                spawn_env["DLCFN_BROKER_SHARD"] = str(shard or 0)
+                spawn_env["DLCFN_BROKER_NSHARDS"] = str(n_shards)
             proc = subprocess.Popen(
                 [str(BROKER_BIN), str(port), ",".join(bind_list)],
                 stdout=log_fh,
                 stderr=subprocess.STDOUT,
                 start_new_session=True,
-                env={
-                    **os.environ,
-                    "DLCFN_BROKER_TOKEN": token,
-                    "DLCFN_BROKER_ROLE": "primary",
-                    "DLCFN_BROKER_EPOCH": str(epoch),
-                    "DLCFN_BROKER_REPL_LOG": str(repl_log),
-                },
+                env=spawn_env,
             )
         finally:
             log_fh.close()
@@ -570,33 +655,34 @@ def ensure_broker(
                 "reach the broker via forwarding to one of: %s",
                 advertise, ",".join(actual_binds),
             )
-        _write_record(
-            rec,
-            {
-                "cluster": cluster_name,
-                "host": host,
-                "port": bound_port,
-                "pid": proc.pid,
-                # What the broker actually listens on (skips removed)
-                # vs what was attempted: reuse compares advertise needs
-                # against ATTEMPTED (retrying a known-unbindable NAT
-                # address is pointless), while the actual list is the
-                # honest record of what serves.
-                "binds": ",".join(actual_binds),
-                "binds_requested": ",".join(requested),
-                # The AUTH shared secret; the record is chmod 0600.
-                "token": token,
-                # Replication metadata (docs/RESILIENCE.md "Broker
-                # failover"): the leadership term this process was fenced
-                # to at spawn, and the ordered dial list handed to
-                # failover clients (endpoints_from_record).  A standby
-                # attach (ensure_standby_broker) appends its address here.
-                "role": "primary",
-                "epoch": epoch,
-                "endpoints": [[host, bound_port]],
-                "started_ts": time.time(),
-            },
-        )
+        record_payload = {
+            "cluster": cluster_name,
+            "host": host,
+            "port": bound_port,
+            "pid": proc.pid,
+            # What the broker actually listens on (skips removed)
+            # vs what was attempted: reuse compares advertise needs
+            # against ATTEMPTED (retrying a known-unbindable NAT
+            # address is pointless), while the actual list is the
+            # honest record of what serves.
+            "binds": ",".join(actual_binds),
+            "binds_requested": ",".join(requested),
+            # The AUTH shared secret; the record is chmod 0600.
+            "token": token,
+            # Replication metadata (docs/RESILIENCE.md "Broker
+            # failover"): the leadership term this process was fenced
+            # to at spawn, and the ordered dial list handed to
+            # failover clients (endpoints_from_record).  A standby
+            # attach (ensure_standby_broker) appends its address here.
+            "role": "primary",
+            "epoch": epoch,
+            "endpoints": [[host, bound_port]],
+            "started_ts": time.time(),
+        }
+        if n_shards is not None:
+            record_payload["shard"] = int(shard or 0)
+            record_payload["n_shards"] = int(n_shards)
+        _write_record(rec, record_payload)
     finally:
         lock.unlink(missing_ok=True)
     log.info(
@@ -657,6 +743,23 @@ def ensure_standby_broker(
     )
     token = primary.get("token") or ""
     epoch = int(primary.get("epoch", 0) or 0)
+    # The standby journals every SYNC entry it applies into its OWN copy
+    # of the journal, seq/epoch-faithful (not a local counter, so replay
+    # after ITS promotion dedups exactly).  Fresh standby, fresh copy.
+    standby_repl = _standby_repl_log_path(cluster_name, root)
+    standby_repl.unlink(missing_ok=True)
+    env = {
+        **os.environ,
+        # Token via env (never argv).
+        "DLCFN_BROKER_TOKEN": token,
+        "DLCFN_BROKER_ROLE": "standby",
+        "DLCFN_BROKER_EPOCH": str(epoch),
+        "DLCFN_BROKER_REPL_LOG": str(standby_repl),
+    }
+    # A shard-stamped primary gets a matching standby (SHARD identity).
+    if primary.get("n_shards"):
+        env["DLCFN_BROKER_SHARD"] = str(primary.get("shard", 0))
+        env["DLCFN_BROKER_NSHARDS"] = str(primary["n_shards"])
     # "wb" for the same stale-"listening on" reason as ensure_broker.
     log_fh = open(log_path, "wb")
     try:
@@ -665,15 +768,7 @@ def ensure_standby_broker(
             stdout=log_fh,
             stderr=subprocess.STDOUT,
             start_new_session=True,
-            # Token via env (never argv); no DLCFN_BROKER_REPL_LOG — only
-            # the primary journals, a standby that journaled its replayed
-            # frames would re-ship them after its own promotion.
-            env={
-                **os.environ,
-                "DLCFN_BROKER_TOKEN": token,
-                "DLCFN_BROKER_ROLE": "standby",
-                "DLCFN_BROKER_EPOCH": str(epoch),
-            },
+            env=env,
         )
     finally:
         log_fh.close()
@@ -705,21 +800,22 @@ def ensure_standby_broker(
         raise BrokerError("standby broker did not become reachable")
 
     host = primary["host"]
-    _write_record(
-        srec,
-        {
-            "cluster": cluster_name,
-            "host": host,
-            "port": bound_port,
-            "pid": proc.pid,
-            "binds": binds,
-            "binds_requested": binds,
-            "token": token or None,
-            "role": "standby",
-            "epoch": epoch,
-            "started_ts": time.time(),
-        },
-    )
+    standby_payload = {
+        "cluster": cluster_name,
+        "host": host,
+        "port": bound_port,
+        "pid": proc.pid,
+        "binds": binds,
+        "binds_requested": binds,
+        "token": token or None,
+        "role": "standby",
+        "epoch": epoch,
+        "started_ts": time.time(),
+    }
+    for key in ("shard", "n_shards"):
+        if key in primary:
+            standby_payload[key] = primary[key]
+    _write_record(srec, standby_payload)
     prec = {k: v for k, v in primary.items() if k != "alive"}
     prec["endpoints"] = [
         [primary["host"], int(primary["port"])],
@@ -801,6 +897,23 @@ class ReplicationStreamer:
             return 0.0
         return max(0.0, self._clock() - float(todo[0].get("ts", 0.0)))
 
+    def _sender_epoch(self) -> int:
+        """The recorded primary's current term: entries ship under
+        ``max(entry epoch, sender epoch)``.  A promoted primary re-plays
+        pre-promotion history to a fresh standby under ITS term (the
+        entries' old epochs would be fenced), while a deposed primary's
+        process cannot launder its stream — adoption atomically rotates
+        the journal file this streamer tails, so the path always names
+        the acting primary's history.  0 (entry epochs verbatim) when no
+        record exists — the injected-connect test seam."""
+        try:
+            record = json.loads(
+                _record_path(self.cluster_name, self._root).read_text()
+            )
+            return int(record.get("epoch", 0) or 0)
+        except (OSError, ValueError):
+            return 0
+
     def step(self) -> int:
         """Ship every unshipped journal entry to the standby; returns how
         many were shipped.  Raises ``BrokerFenced`` (via sync_entry) when
@@ -809,11 +922,12 @@ class ReplicationStreamer:
         todo = self.pending()
         if not todo:
             return 0
+        sender_epoch = self._sender_epoch()
         conn = self._dial_standby()
         try:
             for e in todo:
                 conn.sync_entry(
-                    int(e["epoch"]),
+                    max(int(e["epoch"]), sender_epoch),
                     int(e["seq"]),
                     str(e["frame"]).encode("utf-8"),
                 )
@@ -1131,6 +1245,7 @@ def teardown_broker(cluster_name: str, root: Path | None = None) -> dict:
     rec = _record_path(cluster_name, root)
     standby_result = _reap_standby(cluster_name, root)
     _repl_log_path(cluster_name, root).unlink(missing_ok=True)
+    _standby_repl_log_path(cluster_name, root).unlink(missing_ok=True)
     status = broker_status(cluster_name, root)
     if status is None:
         result = {"broker": "none"}
@@ -1220,3 +1335,137 @@ def teardown_broker(cluster_name: str, root: Path | None = None) -> dict:
         result["standby"] = standby_result
     get_recorder().record("broker_teardown", cluster=cluster_name, **result)
     return result
+
+
+def ensure_sharded_broker(
+    cluster_name: str,
+    n_shards: int,
+    root: Path | None = None,
+    advertise: str | None = None,
+    timeout_s: float = 30.0,
+    standby: bool = True,
+) -> dict:
+    """Bring up (or adopt) a sharded broker deployment: ``n_shards``
+    independent primary/standby pairs, each owning one consistent-hash
+    shard of the queue/KV/heartbeat keyspace (broker_client.shard_for_key).
+
+    Each shard is a full ``ensure_broker`` cluster named
+    ``<cluster>.shard<k>`` — its own record, lock, log, replication
+    journal, epoch fence — so every single-pair mechanism (promotion,
+    fencing, journal rename, auto-re-provision) applies per shard
+    unchanged.  All shards share shard 0's AUTH token so a router holds
+    one credential.  The shard map is written to ``<cluster>.shards.json``
+    and consumed by :func:`sharded_broker_records` /
+    ``ShardedBrokerRouter.for_cluster``.  Idempotent: live shards are
+    reused, dead ones restarted.
+    """
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    shards = []
+    token: str | None = None
+    for k in range(n_shards):
+        shard_name = shard_cluster_name(cluster_name, k)
+        host, port, started = ensure_broker(
+            shard_name,
+            root=root,
+            advertise=advertise,
+            timeout_s=timeout_s,
+            reuse_token=token,
+            shard=k,
+            n_shards=n_shards,
+        )
+        if token is None:
+            token = broker_token(shard_name, root)
+        if standby:
+            ensure_standby_broker(shard_name, root=root, timeout_s=timeout_s)
+        shards.append(
+            {"shard": k, "cluster": shard_name, "host": host, "port": port,
+             "started": started}
+        )
+    _write_record(
+        _shard_map_path(cluster_name, root),
+        {"cluster": cluster_name, "n_shards": n_shards,
+         "shards": [s["cluster"] for s in shards]},
+    )
+    get_recorder().record(
+        "broker_sharded_ensure", cluster=cluster_name, n_shards=n_shards,
+        started=sum(1 for s in shards if s["started"]),
+    )
+    return {"cluster": cluster_name, "n_shards": n_shards, "shards": shards}
+
+
+def sharded_broker_records(
+    cluster_name: str, root: Path | None = None
+) -> list[dict] | None:
+    """Per-shard broker records for a sharded deployment, in ring order —
+    the routing table ``ShardedBrokerRouter.for_cluster`` builds its
+    per-shard endpoint lists from.  None when no shard map is recorded
+    (the cluster is unsharded or torn down).  A shard whose record is
+    missing (mid-teardown, crashed before re-ensure) yields
+    ``record: None`` — the router refuses to run with a hole in the ring
+    rather than silently misrouting its keyspace slice."""
+    try:
+        shard_map = json.loads(_shard_map_path(cluster_name, root).read_text())
+    except (OSError, ValueError):
+        return None
+    return [
+        {"shard": k, "cluster": name, "record": broker_status(name, root)}
+        for k, name in enumerate(shard_map.get("shards", []))
+    ]
+
+
+def broker_shard_replication_status(
+    cluster_name: str, root: Path | None = None, clock=time.time
+) -> dict | None:
+    """Replication health for every shard of a sharded deployment — the
+    ``dlcfn status --broker`` / exporter view.  None when no shard map is
+    recorded.  Each entry is :func:`broker_replication_status` for that
+    shard plus a ``degraded`` flag: True when the pair is not a healthy
+    replicating primary+standby (missing/dead standby, or nonzero lag) —
+    the state the self-healing adoption path exists to make transient,
+    never steady-state."""
+    try:
+        shard_map = json.loads(_shard_map_path(cluster_name, root).read_text())
+    except (OSError, ValueError):
+        return None
+    shards = []
+    for k, name in enumerate(shard_map.get("shards", [])):
+        status = broker_replication_status(name, root, clock=clock)
+        degraded = True
+        if status is not None:
+            standby = status.get("standby")
+            degraded = not (
+                status["primary"]["alive"]
+                and standby is not None
+                and standby.get("alive")
+                and not status.get("lag_entries")
+            )
+        shards.append(
+            {"shard": k, "cluster": name, "status": status, "degraded": degraded}
+        )
+    return {
+        "cluster": cluster_name,
+        "n_shards": len(shards),
+        "shards": shards,
+        "degraded_shards": sum(1 for s in shards if s["degraded"]),
+    }
+
+
+def teardown_sharded_broker(
+    cluster_name: str, root: Path | None = None
+) -> dict:
+    """Tear down every shard of a sharded deployment and forget the shard
+    map.  Safe when none exists (mirrors :func:`teardown_broker`)."""
+    try:
+        shard_map = json.loads(_shard_map_path(cluster_name, root).read_text())
+    except (OSError, ValueError):
+        return {"broker": "none", "shards": []}
+    results = [
+        {"shard": k, "cluster": name, "result": teardown_broker(name, root)}
+        for k, name in enumerate(shard_map.get("shards", []))
+    ]
+    _shard_map_path(cluster_name, root).unlink(missing_ok=True)
+    get_recorder().record(
+        "broker_sharded_teardown", cluster=cluster_name, n_shards=len(results)
+    )
+    return {"cluster": cluster_name, "shards": results}

@@ -62,6 +62,11 @@ BROKER_PROTOCOL_VERBS = (
     "ROLE",     # ROLE                             report role, epoch, repl seq
     "PROMOTE",  # PROMOTE <epoch>                  fence to epoch, become primary
     "SYNC",     # SYNC <epoch> <seq> <nbytes>\n<entry>   replicate one journal frame
+    # -- keyspace sharding (docs/RESILIENCE.md "Sharded broker"): each
+    #    primary/standby pair owns one consistent-hash shard of the
+    #    queue/KV/heartbeat keyspace; SHARD lets a router verify it
+    #    dialed the owner of the keys it routes there.
+    "SHARD",    # SHARD                            report shard index, total shards
 )
 
 

@@ -64,6 +64,12 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
     "dlcfn_broker_up": ("gauge", "1 while the node answers on loopback."),
     "dlcfn_broker_replication_lag_seconds": ("gauge", "Age of the oldest journal entry the standby has not applied."),
     "dlcfn_broker_replication_lag_entries": ("gauge", "Journal entries the standby has not applied."),
+    # sharded broker control plane (one pair per keyspace shard)
+    "dlcfn_broker_shard_role": ("gauge", "Broker role per shard node (1 = primary, 0 = standby)."),
+    "dlcfn_broker_shard_epoch": ("gauge", "Leadership term the shard node is fenced to."),
+    "dlcfn_broker_shard_up": ("gauge", "1 while the shard node answers on loopback."),
+    "dlcfn_broker_shard_replication_lag_seconds": ("gauge", "Age of the oldest journal entry the shard's standby has not applied."),
+    "dlcfn_broker_shard_replication_lag_entries": ("gauge", "Journal entries the shard's standby has not applied."),
     # sharded streaming data plane (train/datastream, docs/DATA.md)
     "dlcfn_datastream_records_per_s": ("gauge", "Records/second the data plane delivered (plane lifetime)."),
     "dlcfn_datastream_records_total": ("counter", "Records the data plane delivered."),
@@ -122,6 +128,31 @@ def fold_reshard_events(events) -> dict[str, Any]:
             out["fallback_total"] += 1
     if not out["total"] and not out["fallback_total"]:
         return {}
+    return out
+
+
+def fold_broker_events(events) -> dict[str, Any]:
+    """Fold flight-journal broker lifecycle events into the counters
+    ``dlcfn status`` surfaces: ``broker_promoted`` (a standby adopted a
+    dead primary's record) and ``standby_reprovisioned`` (the promoted
+    primary healed its pair with a fresh standby — the self-healing half
+    of the failover ladder).  Empty dict when the journal holds neither
+    kind."""
+    out: dict[str, Any] = {"promotions": 0, "reprovisions": 0}
+    last: dict[str, Any] | None = None
+    for event in events:
+        kind = event.get("kind")
+        if kind not in ("broker_promoted", "standby_reprovisioned"):
+            continue
+        out["promotions" if kind == "broker_promoted" else "reprovisions"] += 1
+        last = event
+    if last is None:
+        return {}
+    out["last_event"] = {
+        key: last[key]
+        for key in ("kind", "ts", "cluster", "epoch", "replayed")
+        if key in last
+    }
     return out
 
 
@@ -499,6 +530,53 @@ def render_prometheus(
             lines.append(
                 f"dlcfn_broker_replication_lag_entries{_labels(cluster=cluster)} {lag_entries}"
             )
+        shards = broker.get("shards")
+        if shards:
+            for name in (
+                "dlcfn_broker_shard_role",
+                "dlcfn_broker_shard_epoch",
+                "dlcfn_broker_shard_up",
+            ):
+                head(name)
+            for entry in shards:
+                shard = entry.get("shard")
+                status = entry.get("status") or {}
+                for node_name in ("primary", "standby"):
+                    node = status.get(node_name)
+                    if not node:
+                        continue
+                    labels = _labels(
+                        cluster=cluster,
+                        shard=shard,
+                        node=node_name,
+                        endpoint=f"{node.get('host')}:{node.get('port')}",
+                    )
+                    role = node.get("role")
+                    lines.append(
+                        f"dlcfn_broker_shard_role{labels}"
+                        f" {1 if role == 'primary' else 0}"
+                    )
+                    lines.append(
+                        f"dlcfn_broker_shard_epoch{labels} {node.get('epoch') or 0}"
+                    )
+                    lines.append(
+                        f"dlcfn_broker_shard_up{labels}"
+                        f" {1 if node.get('alive') else 0}"
+                    )
+            for key in ("lag_seconds", "lag_entries"):
+                rows = [
+                    (e.get("shard"), (e.get("status") or {}).get(key))
+                    for e in shards
+                ]
+                rows = [(s, v) for s, v in rows if v is not None]
+                if not rows:
+                    continue
+                head(f"dlcfn_broker_shard_replication_{key}")
+                for shard, value in rows:
+                    lines.append(
+                        f"dlcfn_broker_shard_replication_{key}"
+                        f"{_labels(cluster=cluster, shard=shard)} {value}"
+                    )
     if fleet:
         head("dlcfn_fleet_workers")
         lines.append(

@@ -41,6 +41,7 @@
 //   ROLE\n                                -> ROLE <role> <epoch> <seq>\n
 //   PROMOTE <epoch>\n                     -> OK <epoch>\n | ERR stale epoch\n
 //   SYNC <epoch> <seq> <len>\n<entry>     -> OK <seq>\n | ERR fenced\n
+//   SHARD\n                               -> SHARD <shard> <nshards>\n
 //
 // Replication (docs/RESILIENCE.md "Broker failover"): when
 // DLCFN_BROKER_REPL_LOG names a file, every applied mutation is appended
@@ -52,6 +53,18 @@
 // higher epoch turns it into the new primary, and epoch fencing (SYNC
 // carrying an epoch below the receiver's) rejects a deposed primary's
 // stale stream so a partition cannot produce dual-leader writes.
+// A standby with a repl log journals every SYNC entry it APPLIES at the
+// entry's own seq/epoch (not a local counter), so the log is a faithful
+// copy of the history it acked: after promotion the supervisor renames
+// it over the primary log path and replication resumes from the promoted
+// node's journal into a freshly re-provisioned standby (the self-healing
+// pair, docs/RESILIENCE.md "Sharded broker").
+//
+// Sharding: DLCFN_BROKER_SHARD / DLCFN_BROKER_NSHARDS stamp this process
+// with its slot on the consistent-hash ring (broker_client.shard_for_key
+// owns placement; the broker itself stays key-agnostic).  SHARD reports
+// the stamp so a router can verify it dialed the owner of its keys;
+// an unsharded broker reports 0 1.
 //
 // Heartbeats: the broker stores only last-beat timestamps and counts; the
 // ALIVE/SUSPECT/DEAD interpretation lives Python-side (obs/liveness.py)
@@ -145,6 +158,11 @@ std::string g_role = "primary";
 std::mutex g_repl_mu;
 std::FILE* g_repl_fh = nullptr;  // DLCFN_BROKER_REPL_LOG, nullptr = off
 
+// Keyspace-shard stamp (docs/RESILIENCE.md "Sharded broker"): identity
+// only — placement lives client-side in broker_client.shard_for_key.
+std::atomic<uint64_t> g_shard{0};
+std::atomic<uint64_t> g_nshards{1};
+
 std::string current_role() {
   std::lock_guard<std::mutex> lock(g_role_mu);
   return g_role;
@@ -178,24 +196,31 @@ std::string json_escape(const std::string& s) {
   return out;
 }
 
-// Append one replication entry in the flight-recorder JSONL shape
-// (obs/recorder.py): the streamer tails this file with read_journal /
-// follow_journal and replays each frame into the standby via SYNC.
+// Write one replication entry in the flight-recorder JSONL shape
+// (obs/recorder.py) at an EXPLICIT seq/epoch: the primary path stamps a
+// fresh local seq, the standby path (SYNC) re-journals the incoming
+// entry verbatim so its log is a faithful copy of the acked history.
+void repl_log_write(uint64_t seq, uint64_t epoch, const std::string& frame) {
+  std::lock_guard<std::mutex> lock(g_repl_mu);
+  if (g_repl_fh == nullptr) return;
+  double ts = std::chrono::duration<double>(
+                  std::chrono::system_clock::now().time_since_epoch())
+                  .count();
+  std::fprintf(g_repl_fh,
+               "{\"ts\": %.6f, \"kind\": \"broker_apply\", \"seq\": %llu, "
+               "\"epoch\": %llu, \"frame\": \"%s\"}\n",
+               ts, static_cast<unsigned long long>(seq),
+               static_cast<unsigned long long>(epoch),
+               json_escape(frame).c_str());
+  std::fflush(g_repl_fh);
+}
+
+// Append one entry as primary: the streamer tails this file with
+// read_journal / follow_journal and replays each frame into the standby
+// via SYNC.
 uint64_t repl_append(const std::string& frame) {
   uint64_t seq = ++g_repl_seq;
-  std::lock_guard<std::mutex> lock(g_repl_mu);
-  if (g_repl_fh != nullptr) {
-    double ts = std::chrono::duration<double>(
-                    std::chrono::system_clock::now().time_since_epoch())
-                    .count();
-    std::fprintf(g_repl_fh,
-                 "{\"ts\": %.6f, \"kind\": \"broker_apply\", \"seq\": %llu, "
-                 "\"epoch\": %llu, \"frame\": \"%s\"}\n",
-                 ts, static_cast<unsigned long long>(seq),
-                 static_cast<unsigned long long>(g_epoch.load()),
-                 json_escape(frame).c_str());
-    std::fflush(g_repl_fh);
-  }
+  repl_log_write(seq, g_epoch.load(), frame);
   return seq;
 }
 
@@ -673,6 +698,11 @@ void serve(int fd) {
       resp += "ROLE " + current_role() + " " + std::to_string(g_epoch.load()) +
               " " + std::to_string(seq) + "\n";
       if (!write_all(fd, resp)) break;
+    } else if (cmd == "SHARD") {
+      std::string resp;
+      resp += "SHARD " + std::to_string(g_shard.load()) + " " +
+              std::to_string(g_nshards.load()) + "\n";
+      if (!write_all(fd, resp)) break;
     } else if (cmd == "PROMOTE") {
       uint64_t epoch = 0;
       ss >> epoch;
@@ -711,6 +741,10 @@ void serve(int fd) {
           continue;
         }
         g_sync_seq.store(seq);
+        // Journal the applied entry at ITS seq/epoch: the standby's log
+        // is a faithful copy of the acked history, so a promotion can
+        // resume replication from this journal into a fresh standby.
+        repl_log_write(seq, epoch, entry);
       }
       if (!write_all(fd, "OK " + std::to_string(seq) + "\n")) break;
     } else if (cmd == "GET") {
@@ -788,6 +822,10 @@ int main(int argc, char** argv) {
     g_epoch.store(std::strtoull(epoch, nullptr, 10));
   if (const char* repl = std::getenv("DLCFN_BROKER_REPL_LOG"))
     g_repl_fh = std::fopen(repl, "a");
+  if (const char* shard = std::getenv("DLCFN_BROKER_SHARD"))
+    g_shard.store(std::strtoull(shard, nullptr, 10));
+  if (const char* nshards = std::getenv("DLCFN_BROKER_NSHARDS"))
+    g_nshards.store(std::strtoull(nshards, nullptr, 10));
   int port = argc > 1 ? std::atoi(argv[1]) : 8477;
   std::string addrs_arg = argc > 2 ? argv[2] : "*";
   std::vector<std::string> addrs;

@@ -22,7 +22,10 @@ echo "== chaos scenarios (seeded, virtual-clock — docs/RESILIENCE.md) =="
 # (docs/SERVING.md runbook).  broker-failover runs the 1k-agent
 # warm-standby soak (zero lost INSTANCE_TERMINATE, exactly-once
 # re-sends) and split-brain proves epoch fencing rejects every
-# stale-primary write.
+# stale-primary write.  shard-failover runs the sharded fleet soak
+# (one shard's failover stalls only that shard; every pair auto-heals)
+# and degraded-pair-heal pins the re-provision ladder (fresh standby,
+# lag drained to zero, un-fenced old-term replay).
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -m deeplearning_cfn_tpu.cli chaos --all --seed 0 \
   > /tmp/_chaos.json || { cat /tmp/_chaos.json; exit 1; }
@@ -39,6 +42,7 @@ import json
 reports = json.load(open("/tmp/_chaos.json"))
 names = {r["scenario"] for r in reports}
 for required in ("serve-replica-loss", "broker-failover", "split-brain",
+                 "shard-failover", "degraded-pair-heal",
                  "alert-storm", "data-reshard-live"):
     assert required in names, f"{required} missing from {sorted(names)}"
 EOF

@@ -648,6 +648,89 @@ def broker_soak() -> tuple[dict, list[str]]:
     return soak, failures
 
 
+FLEET_SIM_AGENTS = 10_000
+FLEET_SIM_SHARDS = 8
+
+
+def fleet_sim() -> tuple[dict, list[str]]:
+    """Sharded-fleet stage: the 10k-agent deterministic soak, wall-clock
+    bounded.  Runs :func:`soak_fleet` twice at the same seed on a
+    VirtualClock — concurrent multi-shard failovers, a split brain,
+    auto-re-provision races — and checks (1) exactly-once delivery and
+    zero lost/spurious INSTANCE_TERMINATE at 10k agents, (2) no shard
+    pair left degraded, (3) byte-determinism: both runs serialize to
+    identical JSON, and (4) the hot loop never touches ``time.sleep`` —
+    all waiting is virtual, so the stage's cost is CPU, not wall
+    clock."""
+    import time as _time
+
+    from deeplearning_cfn_tpu.analysis.schedules import soak_fleet
+
+    failures: list[str] = []
+    sleep_calls = 0
+    real_sleep = _time.sleep
+
+    def counting_sleep(seconds: float) -> None:
+        nonlocal sleep_calls
+        sleep_calls += 1
+        real_sleep(seconds)
+
+    _time.sleep = counting_sleep
+    try:
+        first = soak_fleet(agents=FLEET_SIM_AGENTS, shards=FLEET_SIM_SHARDS, seed=0)
+        second = soak_fleet(agents=FLEET_SIM_AGENTS, shards=FLEET_SIM_SHARDS, seed=0)
+    finally:
+        _time.sleep = real_sleep
+    if sleep_calls:
+        failures.append(
+            f"fleet sim hot loop slept {sleep_calls} time(s) — the soak "
+            f"must wait on the VirtualClock only"
+        )
+    serialized = json.dumps(first, sort_keys=True, allow_nan=False)
+    if serialized != json.dumps(second, sort_keys=True, allow_nan=False):
+        diff = {
+            k for k in set(first) | set(second) if first.get(k) != second.get(k)
+        }
+        failures.append(
+            f"fleet sim not byte-deterministic at seed 0: fields {sorted(diff)}"
+        )
+    if first["lost_terminates"] or first["terminated"] != first["killed"]:
+        failures.append(
+            f"fleet sim lost terminates: {first['terminated']} of "
+            f"{first['killed']} killed agents terminated "
+            f"({first['lost_terminates']} lost)"
+        )
+    for kind in ("spurious", "duplicate", "premature"):
+        if first[f"{kind}_terminates"]:
+            failures.append(
+                f"fleet sim produced {first[f'{kind}_terminates']} "
+                f"{kind} terminates"
+            )
+    expected = first["senders"] + first["stale_writes"]
+    if first["duplicate_sends"] or first["delivered"] != expected:
+        failures.append(
+            f"fleet sim delivery not exactly-once: {first['delivered']} "
+            f"delivered of {expected} sent, "
+            f"{first['duplicate_sends']} duplicates"
+        )
+    if first["degraded_pairs"]:
+        failures.append(
+            f"fleet sim left {first['degraded_pairs']} shard pair(s) "
+            f"degraded after auto-heal"
+        )
+    if first["diverged_entries"]:
+        failures.append(
+            f"split-brain shard diverged by {first['diverged_entries']} "
+            f"entries past the fence"
+        )
+    if first["unaffected_shard_failovers"]:
+        failures.append(
+            f"failovers leaked across shards: {first['unaffected_shard_failovers']} "
+            f"client failovers on healthy shards"
+        )
+    return first, failures
+
+
 def main() -> int:
     u8_snap, u8_x = run_pipeline("uint8")
     f32_snap, f32_x = run_pipeline("float32")
@@ -735,6 +818,9 @@ def main() -> int:
     broker_snap, broker_failures = broker_soak()
     failures.extend(broker_failures)
 
+    fleet_snap, fleet_failures = fleet_sim()
+    failures.extend(fleet_failures)
+
     telem_snap, telem_failures = telemetry_overhead()
     failures.extend(telem_failures)
 
@@ -765,6 +851,7 @@ def main() -> int:
                 "overlap": overlap_snap,
                 "serve": serve_snap,
                 "broker_failover": broker_snap,
+                "fleet_sim": fleet_snap,
                 "telemetry": telem_snap,
                 "datastream": datastream_snap,
                 "comms": comms_snap,

@@ -178,6 +178,75 @@ def test_non_endpoint_errors_propagate():
     assert conn.breaker(("a", 1)).consecutive_failures == 0
 
 
+def test_refreshes_endpoints_from_rewritten_record():
+    """Satellite #1 of the sharded control plane: adoption +
+    auto-re-provision REWRITES the broker record (promoted standby first,
+    fresh standby appended), so a client built from the stale endpoint
+    list must re-read it once the walk exhausts — and reach the
+    re-provisioned pair instead of erroring out on addresses that no
+    longer serve."""
+    a = FakeBroker(primary=True)
+    b = FakeBroker(primary=False)
+    c = FakeBroker(primary=False)
+    table = {("a", 1): a, ("b", 2): b, ("c", 3): c}
+
+    def dial(host, port):
+        broker = table[(host, port)]
+        broker.dials += 1
+        if not broker.up:
+            raise ConnectionError("connection refused")
+        return FakeConn(broker)
+
+    record_endpoints = [[("a", 1), ("b", 2)]]  # mutable "record file"
+    conn = FailoverBrokerConnection(
+        record_endpoints[0],
+        dial=dial,
+        clock=FakeClock(),
+        endpoints_source=lambda: record_endpoints[0],
+    )
+    assert conn.ping()
+    survivor_breaker = conn.breaker(("b", 2))
+
+    # Primary dies; the standby is adopted, re-provisions a fresh standby
+    # at ('c', 3), and the record is rewritten with the new pair.
+    a.up = False
+    b.primary = True
+    record_endpoints[0] = [("b", 2), ("c", 3)]
+
+    assert conn.send_idempotent("work", b"job", "r1") == "r1"
+    assert b.sent == [("work", "r1", b"job")]
+
+    # Now the promoted node dies too: only the REFRESHED list knows about
+    # ('c', 3) — the stale list would dead-end.
+    b.up = False
+    c.primary = True
+    record_endpoints[0] = [("c", 3), ("b", 2)]
+    assert conn.send_idempotent("work", b"job2", "r2") == "r2"
+    assert c.sent == [("work", "r2", b"job2")]
+    assert conn.endpoint_refreshes == 1
+    assert conn.active_endpoint == ("c", 3)
+    # The surviving endpoint kept its breaker (its failure history is its
+    # own); the vanished endpoint's breaker was dropped with it.
+    assert conn.breaker(("b", 2)) is survivor_breaker
+    assert ("a", 1) not in conn._breakers
+
+
+def test_refresh_unchanged_list_still_raises():
+    """When the record has NOT been rewritten, the refresh pass is a
+    no-op and the walk's BrokerError propagates — no infinite retry."""
+    a, b, dial = make_pair()
+    a.up = b.up = False
+    conn = FailoverBrokerConnection(
+        [("a", 1), ("b", 2)],
+        dial=dial,
+        clock=FakeClock(),
+        endpoints_source=lambda: [("a", 1), ("b", 2)],
+    )
+    with pytest.raises(BrokerError, match="no broker endpoint available"):
+        conn.ping()
+    assert conn.endpoint_refreshes == 0
+
+
 def test_endpoints_from_record_shapes():
     replicated = {
         "host": "10.0.0.1",

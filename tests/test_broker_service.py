@@ -548,10 +548,20 @@ def test_standby_lifecycle_and_adoption(tmp_path):
         rec2 = json.loads((tmp_path / "broker" / "svc.json").read_text())
         assert rec2["role"] == "primary"
         assert int(rec2["epoch"]) >= 1  # the promotion ladder bumped it
-        assert not sb_rec_file.exists()  # the replica slot is vacated
+        # Self-healing: adoption re-provisioned a FRESH standby into the
+        # vacated replica slot — a degraded pair is never steady state.
+        sb2 = json.loads(sb_rec_file.read_text())
+        assert int(sb2["port"]) != sb_port  # a new process, not the promotee
+        assert rec2["endpoints"] == [
+            ["127.0.0.1", sb_port], [sb2["host"], int(sb2["port"])]
+        ]
         repl2 = broker_replication_status("svc", root=tmp_path)
         assert repl2["primary"]["role"] == "primary"
         assert repl2["primary"]["alive"] is True
+        assert repl2["standby"] is not None
+        assert repl2["standby"]["alive"] is True
+        assert repl2["standby"]["role"] == "standby"
+        assert repl2["lag_entries"] == 0
     finally:
         out = teardown_broker("svc", root=tmp_path)
     assert broker_status("svc", root=tmp_path) is None
@@ -601,6 +611,7 @@ def test_teardown_reaps_standby_and_replication_log(tmp_path):
         os.kill(sb_pid, 0)
     assert not (tmp_path / "broker" / "svc.standby.json").exists()
     assert not (tmp_path / "broker" / "svc.repl.jsonl").exists()
+    assert not (tmp_path / "broker" / "svc.standby.repl.jsonl").exists()
 
 
 def test_advertise_address_is_recorded(tmp_path):
@@ -619,3 +630,76 @@ def test_advertise_address_is_recorded(tmp_path):
         assert _alive("127.0.0.1", port)
     finally:
         teardown_broker("adv", root=tmp_path)
+
+
+# --- sharded control plane ---------------------------------------------------
+
+
+def test_sharded_broker_lifecycle_and_routing(tmp_path):
+    """The sharded deployment end to end: ensure brings up N independent
+    primary/standby pairs sharing one AUTH token, each fenced to its
+    shard of the keyspace (SHARD verb); the router hashes keys to the
+    owning pair; per-shard replication status reports no pair degraded;
+    teardown reaps every shard and the map."""
+    from deeplearning_cfn_tpu.cluster.broker_client import (
+        ShardedBrokerRouter,
+        shard_for_key,
+    )
+    from deeplearning_cfn_tpu.cluster.broker_service import (
+        broker_shard_replication_status,
+        ensure_sharded_broker,
+        sharded_broker_records,
+        teardown_sharded_broker,
+    )
+
+    out = ensure_sharded_broker("svc", 2, root=tmp_path)
+    try:
+        assert out["n_shards"] == 2 and len(out["shards"]) == 2
+        records = sharded_broker_records("svc", root=tmp_path)
+        assert [e["shard"] for e in records] == [0, 1]
+        tokens = set()
+        for entry in records:
+            rec = entry["record"]
+            assert rec is not None and rec["alive"] is True
+            assert rec["shard"] == entry["shard"] and rec["n_shards"] == 2
+            assert len(rec["endpoints"]) == 2  # primary + warm standby
+            tokens.add(rec["token"])
+        assert len(tokens) == 1 and tokens != {None}  # one shared secret
+
+        router = ShardedBrokerRouter.for_cluster("svc", root=tmp_path)
+        try:
+            assert router.ping() is True
+            # Each shard's broker knows its slot in the ring.
+            for k, conn in enumerate(router.shard_connections()):
+                assert conn.shard() == (k, 2)
+            # A queue lands on — and only on — the pair the hash names.
+            queue = "work/route-check"
+            owner = shard_for_key(queue, 2)
+            assert router.shard_index(queue) == owner
+            router.send_idempotent(queue, b"job", "r1")
+            for k, conn in enumerate(router.shard_connections()):
+                assert conn.depth(queue) == (1 if k == owner else 0)
+        finally:
+            router.close()
+
+        # Replication is per shard: draining the owner's journal restores
+        # zero lag everywhere (the other shard never had any).
+        from deeplearning_cfn_tpu.cluster.broker_service import (
+            ReplicationStreamer,
+        )
+
+        shipped = ReplicationStreamer(
+            f"svc.shard{owner}", root=tmp_path
+        ).step()
+        assert shipped == 1
+        repl = broker_shard_replication_status("svc", root=tmp_path)
+        assert repl["n_shards"] == 2 and repl["degraded_shards"] == 0
+        for row in repl["shards"]:
+            assert row["status"]["primary"]["alive"] is True
+            assert row["status"]["standby"]["alive"] is True
+    finally:
+        down = teardown_sharded_broker("svc", root=tmp_path)
+    assert {r["result"]["broker"] for r in down["shards"]} == {"stopped"}
+    assert sharded_broker_records("svc", root=tmp_path) is None
+    for k in range(2):
+        assert broker_status(f"svc.shard{k}", root=tmp_path) is None

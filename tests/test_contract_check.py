@@ -19,12 +19,12 @@ def test_real_repo_layers_agree():
 
 
 def test_layer_extraction_matches_canonical_set():
-    """Each extractor independently recovers the full 16-verb protocol —
+    """Each extractor independently recovers the full 17-verb protocol —
     the guarantee that an empty-extraction bug can't make agreement
     vacuous."""
     canon, _ = cc.canonical_verbs()
     assert canon == set(BROKER_PROTOCOL_VERBS)
-    assert len(canon) == 16
+    assert len(canon) == 17
     assert "HEARTBEAT" in canon  # the obs-plane liveness verb
     assert "TELEM" in canon  # the fleet-telemetry verb rides the same plane
     assert "PROMOTE" in canon  # the replication/failover verbs ride along

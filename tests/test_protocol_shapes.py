@@ -41,12 +41,15 @@ def test_shape_extraction_is_not_vacuous():
     assert canon["ROLE"] == {(0, False)}
     assert canon["PROMOTE"] == {(1, False)}
     assert canon["SYNC"] == {(3, True)}  # SYNC <epoch> <seq> <nbytes>
+    # The keyspace-sharding verb (sharded broker control plane).
+    assert canon["SHARD"] == {(0, False)}
 
     cpp = ps.cpp_request_shapes()
     assert cpp["RECV"] == (3, False)
     assert cpp["SET"][1] is True  # kv write reads a payload
     assert cpp["SYNC"] == (3, True)  # journal frame rides the payload
     assert cpp["PROMOTE"] == (1, False)
+    assert cpp["SHARD"] == (0, False)
 
     client_tokens, client_frames = ps.client_reply_contract()
     assert "PONG" in client_tokens["PING"]
@@ -56,6 +59,8 @@ def test_shape_extraction_is_not_vacuous():
     assert client_frames["TELEM"]["TM"] == {5}
     # ROLE replies with a 4-token frame: ROLE <role> <epoch> <seq>.
     assert client_frames["ROLE"]["ROLE"] == {4}
+    # SHARD replies with a 3-token frame: SHARD <shard> <nshards>.
+    assert client_frames["SHARD"]["SHARD"] == {3}
 
     cpp_tokens, cpp_frames = ps.cpp_reply_contract()
     assert "PONG" in cpp_tokens["PING"]
@@ -63,6 +68,7 @@ def test_shape_extraction_is_not_vacuous():
     assert cpp_frames["HEARTBEAT"]["HB"] == 4
     assert cpp_frames["ROLE"]["ROLE"] == 4
     assert cpp_frames["TELEM"]["TM"] == 5
+    assert cpp_frames["SHARD"]["SHARD"] == 3
 
 
 def _mutated(tmp_path: Path, src: Path, old: str, new: str) -> Path:
