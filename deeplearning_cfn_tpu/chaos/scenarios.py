@@ -39,6 +39,13 @@ Catalog:
   restarts, no lost steps, preserved global batch (grad-accum rescale)
   and loss continuity against an uninterrupted run; the forced-fallback
   variant must degrade to the checkpoint/restore path and still line up.
+* ``sched-flash-crowd`` — multi-tenancy: a flash crowd pages the serve
+  SLO while a replica dies mid-crowd; the fleet arbiter preempts the
+  train job's non-anchor slice (live reshard, grad-accum rescale) and
+  lends it to the serve pool, then reclaims and re-grows bit-safely
+  when the page resolves — train loss continuity, exactly-once
+  fire/resolve, zero lost requests, and a crash mid-preemption resumes
+  from the journaled ledger without repeating the preemption.
 * ``data-reshard-live`` — the data plane's turn: four hosts stream real
   DLC1 record shards, a slice dies mid-epoch, and the live reshard must
   hand the unfinished work to the survivors with every record consumed
@@ -2329,6 +2336,535 @@ def alert_storm(seed: int) -> ScenarioReport:
     return report
 
 
+# --- sched-flash-crowd -------------------------------------------------------
+
+
+def sched_flash_crowd(seed: int) -> ScenarioReport:
+    """Competing train+serve jobs under a flash crowd; the arbiter preempts.
+
+    The multi-tenancy gate (docs/SCHEDULER.md), end-to-end on virtual
+    time: a FleetArbiter places a ``prod-serve`` chat job (slice s0, two
+    replicas) and a ``prod-train`` FSDP job (slices s1+s2, a REAL
+    8-device SPMD trainer) on one 3-slice inventory.  A seeded flash
+    crowd floods the serve pool while — mid-crowd — one of its replicas
+    dies outright; the inflight SLO rule pages, the arbiter preempts the
+    train job's non-anchor slice (live reshard 8 -> 4 devices, grad
+    accum 1 -> 2 preserving the global batch) and lends it to the serve
+    pool as a fresh replica.  The crowd draining resolves the page; the
+    arbiter reclaims the replica (stragglers replayed — zero loss) and
+    re-grows the mesh, returning grad accum to exactly 1
+    (``symmetric_accum`` — the restore is bit-safe, not merely monotone).
+
+    Invariants: train loss-continuity against an uninterrupted 8-device
+    run; the SLO fires and resolves exactly once; zero lost serve
+    requests through BOTH the replica death and the pool resizes;
+    exactly one ``sched_preempt`` and one ``sched_restore`` in the
+    journal; and an arbiter crashed mid-preemption resumes from the
+    broker-persisted ledger absorbing a replayed page WITHOUT repeating
+    the preemption.
+    """
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import dataclasses
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import flax.linen as nn
+
+    from deeplearning_cfn_tpu.analysis.schedules import VirtualClock
+    from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+    from deeplearning_cfn_tpu.cluster.elasticity import (
+        ElasticityController,
+        GroupPolicy,
+    )
+    from deeplearning_cfn_tpu.cluster.recovery import LiveReshardManager
+    from deeplearning_cfn_tpu.models.llama import LlamaConfig, init_params
+    from deeplearning_cfn_tpu.obs.recorder import get_recorder
+    from deeplearning_cfn_tpu.obs.slo import SloEngine, SloRule
+    from deeplearning_cfn_tpu.parallel.mesh import (
+        MeshSpec,
+        hybrid_mesh_for_slices,
+        virtual_cpu_devices,
+    )
+    from deeplearning_cfn_tpu.provision.events import (
+        EventBus,
+        EventKind,
+        LifecycleEvent,
+    )
+    from deeplearning_cfn_tpu.sched import (
+        LEDGER_KEY,
+        FleetArbiter,
+        JobSpec,
+        PreemptionDriver,
+        ServePoolHandle,
+        TrainJobHandle,
+    )
+    from deeplearning_cfn_tpu.serve import (
+        ContinuousBatchingEngine,
+        ServeConfig,
+        ServeFrontEnd,
+        ServeReplica,
+        ServeRequest,
+    )
+    from deeplearning_cfn_tpu.train.data import SyntheticDataset
+    from deeplearning_cfn_tpu.train.reshard import (
+        LiveReshardCoordinator,
+        mesh_topology,
+    )
+    from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
+
+    report = ScenarioReport("sched-flash-crowd", seed)
+    devices = virtual_cpu_devices(8)
+    vclock = VirtualClock()
+
+    class _MLP(nn.Module):
+        # Same shape as slice-loss-live: fc2's 256x256 kernel clears the
+        # FSDP min_shard_elems heuristic, so the reshard moves genuinely
+        # sharded arrays in both directions.
+        @nn.compact
+        def __call__(self, x):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(256, name="fc1")(x))
+            x = nn.relu(nn.Dense(256, name="fc2")(x))
+            return nn.Dense(10, name="head")(x)
+
+    class _Backend:
+        def __init__(self):
+            self.events = EventBus()
+
+    class _Store:
+        """Broker KV stand-in the ledger persists through."""
+
+        def __init__(self):
+            self.table: dict[str, str] = {}
+
+        def set(self, key: str, value: str) -> None:
+            self.table[key] = value
+
+        def get(self, key: str) -> str | None:
+            return self.table.get(key)
+
+    # --- the fleet: 3 slices, 2 hosts x 2 chips each --------------------
+    fleet = ClusterContract.build(
+        cluster_name="chaos-sched",
+        coordinator_ip="10.0.0.1",
+        other_worker_ips=[f"10.0.0.{i}" for i in range(2, 7)],
+        chips_per_worker=2,
+        storage_mount="/mnt/none",
+        slices={
+            "s0": ["10.0.0.1", "10.0.0.2"],
+            "s1": ["10.0.0.3", "10.0.0.4"],
+            "s2": ["10.0.0.5", "10.0.0.6"],
+        },
+    )
+
+    def train_contract() -> ClusterContract:
+        return ClusterContract.build(
+            cluster_name="chaos-sched-train",
+            coordinator_ip="10.0.0.3",
+            other_worker_ips=["10.0.0.4", "10.0.0.5", "10.0.0.6"],
+            chips_per_worker=2,
+            storage_mount="/mnt/none",
+            slices={
+                "s1": ["10.0.0.3", "10.0.0.4"],
+                "s2": ["10.0.0.5", "10.0.0.6"],
+            },
+        )
+
+    def mesh_for(contract: ClusterContract):
+        n = contract.slices_count
+        per_slice = contract.total_chips // max(n, 1)
+        return hybrid_mesh_for_slices(
+            n,
+            ici_spec=MeshSpec.fsdp_parallel(per_slice),
+            dcn_axis="dp",
+            devices=devices[: contract.total_chips],
+        )
+
+    # --- serve pool on s0 ------------------------------------------------
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny(vocab_size=64, seq_len=64), dtype=jnp.float32
+    )
+    params = init_params(cfg, jax.random.key(0))
+
+    def make_engine(name: str, slots: int) -> ContinuousBatchingEngine:
+        scfg = ServeConfig(
+            num_slots=slots, block_size=4, blocks_per_slot=8, prefill_len=16
+        )
+        return ContinuousBatchingEngine(
+            cfg, params, scfg, clock=vclock, name=name, journal=False
+        )
+
+    frontend = ServeFrontEnd(
+        [
+            ServeReplica(make_engine(name, slots=4), name, group="serve")
+            for name in ("pool-a", "pool-b")
+        ]
+    )
+
+    # --- cluster control plane -------------------------------------------
+    backend = _Backend()
+    controller = ElasticityController(
+        backend=backend,
+        coordinator_queue_name="coord",
+        on_instance_loss=frontend.on_instance_loss,
+        slice_loss_window_s=1.0,
+        clock=vclock,
+    )
+    controller.register(GroupPolicy("serve", 1, "sig-serve"))
+    controller.register(GroupPolicy("s1", 1, "sig-s1", coordinator=True))
+    controller.register(GroupPolicy("s2", 1, "sig-s2"))
+    controller.attach()
+    manager = LiveReshardManager(train_contract())
+    manager.attach(controller)
+
+    # --- the arbiter and its driver --------------------------------------
+    store = _Store()
+    driver = PreemptionDriver()
+    driver.register_train("train-fsdp", TrainJobHandle(manager, bus=backend.events))
+    driver.register_serve(
+        "serve-chat",
+        ServePoolHandle(
+            frontend,
+            # A whole lent slice is a bigger replica than the s0 pair's
+            # colocated pair.  6 slots, not 8: the soak test's engine is
+            # num_slots=8 with the same tiny model, and sharing its exact
+            # decode shape would pre-warm the jit cache its
+            # one-compile-at-warmup assertion watches.
+            spawn=lambda name: ServeReplica(
+                make_engine(name, slots=6), name, group="serve"
+            ),
+        ),
+    )
+    arbiter = FleetArbiter.from_contract(fleet, store=store, driver=driver)
+    arbiter.attach(backend.events)
+    controller.add_safe_point_hook(arbiter.reconcile)
+
+    arbiter.submit(
+        JobSpec(name="serve-chat", kind="serve", priority="prod-serve")
+    )
+    arbiter.submit(
+        JobSpec(
+            name="train-fsdp",
+            kind="train",
+            priority="prod-train",
+            min_slices=1,
+            max_slices=2,
+        )
+    )
+    initial_assignments = {j: list(s) for j, s in arbiter.assignments.items()}
+    report.check(
+        initial_assignments
+        == {"serve-chat": ["s0"], "train-fsdp": ["s1", "s2"]},
+        "placer gave prod-serve the first slice and prod-train the rest "
+        "(floors then priority-ordered fill)",
+    )
+
+    # The page rule: total inflight (queued + slotted) across the pool.
+    # Inflight is invariant under replay/resize (requests move between
+    # replicas, the total only drains), so a monotone drain produces
+    # exactly one fire and one resolve — no flap at the reclaim.
+    rule = SloRule(
+        name="serve-queue-depth",
+        metric="dlcfn_serve_queue_depth",
+        agg="sum",
+        op=">",
+        threshold=12.0,
+        for_s=2.0,
+        severity="page",
+        description="chaos: pool inflight beyond the two-replica budget",
+    )
+    slo = SloEngine(rules=(rule,), clock=vclock, bus=backend.events)
+
+    def inflight_values() -> dict:
+        return {
+            "dlcfn_serve_queue_depth": {
+                "sum": float(
+                    sum(r.load for r in frontend.replicas.values())
+                )
+            }
+        }
+
+    # --- the trainer ------------------------------------------------------
+    total_steps = 16
+    dataset = lambda: SyntheticDataset(  # noqa: E731 - fresh iterator per run
+        shape=(8, 8, 1), num_classes=10, batch_size=32, seed=seed
+    )
+    sample = next(iter(dataset().batches(1))).x
+
+    def make_config() -> TrainerConfig:
+        return TrainerConfig(
+            optimizer="adamw",
+            learning_rate=1e-3,
+            strategy="fsdp",
+            matmul_precision="float32",
+            log_every=1,
+            grad_accum_steps=1,
+        )
+
+    def run_straight() -> list[float]:
+        trainer = Trainer(_MLP(), mesh_for(train_contract()), make_config())
+        state = trainer.init(jax.random.PRNGKey(seed), sample)
+        _, losses = trainer.fit(
+            state, dataset().batches(total_steps), steps=total_steps, prefetch=0
+        )
+        return losses
+
+    straight = run_straight()
+
+    coordinator = LiveReshardCoordinator(
+        manager=manager,
+        mesh_for=mesh_for,
+        flush=controller.flush_slice_losses,
+        clock=vclock,
+        symmetric_accum=True,
+    )
+    trainer = Trainer(_MLP(), mesh_for(manager.contract), make_config())
+    state = trainer.init(jax.random.PRNGKey(seed), sample)
+
+    # --- the world, one round per train step ------------------------------
+    # Arrivals per round: calm, a 3-round flash crowd, then the tail.
+    schedule = {0: 2, 1: 2, 2: 8, 3: 8, 4: 8, 5: 1, 6: 1}
+    kill_round = 3 + seed % 2
+    victim = "pool-a" if seed % 2 == 0 else "pool-b"
+    rng = np.random.default_rng(seed)
+    submitted: list[str] = []
+    killed: list[str] = []
+    timeline: list[tuple[int, str, str]] = []
+    captured: dict[str, Any] = {
+        "ledger": None,
+        "assignments": None,
+        "mid_topo": None,
+        "mid_accum": None,
+    }
+    before = {
+        kind: _journal_count(kind)
+        for kind in (
+            "sched_preempt",
+            "sched_restore",
+            "serve_failover",
+            "serve_pool_resize",
+            "reshard",
+            "grad_accum_rescaled",
+            "slice_restore_armed",
+        )
+    }
+
+    def one_round(round_no: int) -> None:
+        for _ in range(schedule.get(round_no, 0)):
+            rid = f"req-{len(submitted):03d}"
+            prompt = rng.integers(
+                1, 64, size=int(rng.integers(4, 12)), dtype=np.int32
+            )
+            frontend.submit(
+                ServeRequest(rid, prompt, max_new_tokens=4),
+                arrival_s=vclock(),
+            )
+            submitted.append(rid)
+        if round_no == kill_round and not killed:
+            killed.append(victim)
+            backend.events.publish(
+                LifecycleEvent(
+                    kind=EventKind.INSTANCE_TERMINATE,
+                    group="serve",
+                    instance_id=f"serve/{victim}",
+                    detail={"reason": "chaos"},
+                )
+            )
+        frontend.step_all()
+        vclock.advance(1.0)
+        for t in slo.evaluate(inflight_values()):
+            timeline.append((round_no, t["rule"], t["state"]))
+        # Crash evidence: the ledger as persisted right after the
+        # preemption, while its loan is still outstanding.
+        if captured["ledger"] is None and arbiter.counters["preemptions"] == 1:
+            captured["ledger"] = store.get(LEDGER_KEY)
+            captured["assignments"] = {
+                j: list(s) for j, s in arbiter.assignments.items()
+            }
+        if captured["mid_topo"] is None and coordinator.live_total == 1:
+            captured["mid_topo"] = mesh_topology(trainer.mesh)
+            captured["mid_accum"] = trainer.config.grad_accum_steps
+
+    def world(src):
+        for i, b in enumerate(src):
+            one_round(i)
+            yield b
+
+    state, live_losses = trainer.fit(
+        state,
+        world(dataset().batches(total_steps)),
+        steps=total_steps,
+        prefetch=0,
+        reshard=coordinator,
+    )
+
+    # Drain the serve tail (train is done; the pool keeps stepping).
+    drain_rounds = 0
+    while frontend.pending() and drain_rounds < 200:
+        frontend.step_all()
+        vclock.advance(1.0)
+        drain_rounds += 1
+
+    # --- train-side invariants -------------------------------------------
+    report.check(
+        len(live_losses) == total_steps
+        and int(jax.device_get(state.step)) == total_steps,
+        "train survived preempt AND restore in one fit() call "
+        "(no restart, monotone step count)",
+    )
+    report.check(
+        coordinator.live_total == 2
+        and coordinator.fallback_total == 0
+        and _journal_count("reshard") - before["reshard"] == 2,
+        "exactly two live reshards: the preempt shrink and the off-peak "
+        "re-grow, zero fallbacks",
+    )
+    report.check(
+        captured["mid_topo"] == {"devices": 4, "axes": {"fsdp": 4}}
+        and captured["mid_accum"] == 2,
+        "preempted mesh was the 4-device fsdp survivor with grad accum "
+        "rescaled 1 -> 2 (global batch preserved)",
+    )
+    report.check(
+        mesh_topology(trainer.mesh)
+        == {"devices": 8, "axes": {"dp": 2, "fsdp": 4}}
+        and manager.contract.slices_count == 2
+        and trainer.config.grad_accum_steps == 1
+        and _journal_count("grad_accum_rescaled")
+        - before["grad_accum_rescaled"]
+        == 2
+        and _journal_count("slice_restore_armed")
+        - before["slice_restore_armed"]
+        == 1,
+        "restore was bit-safe: full 2-slice mesh re-formed and grad "
+        "accum returned to exactly 1 (symmetric rescale, journaled)",
+    )
+    report.check(
+        bool(np.allclose(live_losses[:5], straight[:5], rtol=1e-5, atol=1e-6)),
+        "pre-preemption losses identical to the uninterrupted run",
+    )
+    report.check(
+        bool(np.allclose(live_losses, straight, rtol=5e-3, atol=1e-4)),
+        "loss continuity through preempt and restore: full curve matches "
+        "the uninterrupted 8-device run within tolerance",
+    )
+
+    # --- serve-side invariants -------------------------------------------
+    report.check(
+        len(frontend.completions) == len(submitted)
+        and not frontend.lost_requests(),
+        f"zero lost requests: all {len(submitted)} accepted requests "
+        "completed through the replica death and both pool resizes",
+    )
+    report.check(
+        frontend.failed == [victim]
+        and _journal_count("serve_failover") - before["serve_failover"] == 1,
+        "the mid-crowd replica death failed over exactly once",
+    )
+    report.check(
+        _journal_count("serve_pool_resize") - before["serve_pool_resize"] == 2,
+        "journal shows exactly two pool resizes: the lend and the reclaim",
+    )
+
+    # --- arbiter invariants ----------------------------------------------
+    snap = slo.snapshot()[rule.name]
+    report.check(
+        arbiter.alert_counts == {rule.name: {"firing": 1, "resolved": 1}}
+        and snap["fired_count"] == 1
+        and snap["resolved_count"] == 1,
+        "the SLO paged exactly once and resolved exactly once "
+        "(engine and arbiter agree)",
+    )
+    report.check(
+        arbiter.counters["preemptions"] == 1
+        and arbiter.counters["restores"] == 1
+        and _journal_count("sched_preempt") - before["sched_preempt"] == 1
+        and _journal_count("sched_restore") - before["sched_restore"] == 1,
+        "exactly one preemption and one restore, counted and journaled",
+    )
+    report.check(
+        {j: list(s) for j, s in arbiter.assignments.items()}
+        == initial_assignments
+        and arbiter.loans == []
+        and captured["assignments"]
+        == {"serve-chat": ["s0", "s2"], "train-fsdp": ["s1"]},
+        "the loan round-tripped: s2 to the serve pool during the crowd, "
+        "back to the train job after, no loan left open",
+    )
+
+    # --- crash mid-preemption: resume must not repeat it ------------------
+    def _absorbed_count() -> int:
+        return sum(
+            1
+            for e in get_recorder().tail(4096)
+            if e.get("kind") == "sched_decision"
+            and e.get("action") == "page-absorbed"
+        )
+
+    resumed_ok = False
+    if captured["ledger"] is not None:
+        store2 = _Store()
+        store2.table[LEDGER_KEY] = captured["ledger"]
+        arbiter2 = FleetArbiter.resume(store2)
+        preempts_before = _journal_count("sched_preempt")
+        absorbed_before = _absorbed_count()
+        # The page that caused the preemption, replayed post-crash.
+        arbiter2.on_event(
+            LifecycleEvent(
+                kind=EventKind.ALERT,
+                group="fleet",
+                detail={
+                    "rule": rule.name,
+                    "state": "firing",
+                    "value": 13.0,
+                    "severity": "page",
+                },
+            )
+        )
+        actions = arbiter2.reconcile()
+        resumed_ok = (
+            actions == []
+            and _journal_count("sched_preempt") - preempts_before == 0
+            and _absorbed_count() - absorbed_before == 1
+            and {j: list(s) for j, s in arbiter2.assignments.items()}
+            == captured["assignments"]
+            and json.loads(captured["ledger"])["loans"][0]["slice"] == "s2"
+        )
+    report.check(
+        resumed_ok,
+        "arbiter crashed mid-preemption resumed from the persisted ledger "
+        "and ABSORBED the replayed page — no repeated preemption",
+    )
+
+    report.details.update(
+        schedule={str(k): v for k, v in sorted(schedule.items())},
+        kill_round=kill_round,
+        victim=victim,
+        timeline=timeline,
+        requests=len(submitted),
+        completions=len(frontend.completions),
+        replayed=sorted(set(frontend.replayed)),
+        drain_rounds=drain_rounds,
+        mid_topology=captured["mid_topo"],
+        post_topology=mesh_topology(trainer.mesh),
+        grad_accum_mid=captured["mid_accum"],
+        grad_accum_final=trainer.config.grad_accum_steps,
+        straight_losses=[round(v, 6) for v in straight],
+        live_losses=[round(v, 6) for v in live_losses],
+        arbiter_counters=dict(arbiter.counters),
+    )
+    return report
+
+
 SCENARIOS: dict[str, Callable[[int], ScenarioReport]] = {
     "silent-death": silent_death,
     "partition": partition,
@@ -2343,6 +2879,7 @@ SCENARIOS: dict[str, Callable[[int], ScenarioReport]] = {
     "shard-failover": shard_failover,
     "degraded-pair-heal": degraded_pair_heal,
     "alert-storm": alert_storm,
+    "sched-flash-crowd": sched_flash_crowd,
 }
 
 

@@ -1420,6 +1420,67 @@ def cmd_serve(args) -> int:
     return 0 if report.completed == traffic.requests else 1
 
 
+class _FileLedger:
+    """File-backed stand-in for the broker KV (``set``/``get`` duck type)
+    so ``dlcfn sched`` works against a plain JSON file — the production
+    path stores the same ledger through a BrokerConnection."""
+
+    def __init__(self, path: Path):
+        self.path = path
+
+    def get(self, key: str) -> str | None:
+        if not self.path.exists():
+            return None
+        table = json.loads(self.path.read_text() or "{}")
+        return table.get(key)
+
+    def set(self, key: str, value: str) -> None:
+        table = {}
+        if self.path.exists():
+            table = json.loads(self.path.read_text() or "{}")
+        table[key] = value
+        self.path.write_text(json.dumps(table, sort_keys=True))
+
+
+def cmd_sched(args) -> int:
+    """dlcfn sched: inspect or build the fleet arbiter's ledger
+    (docs/SCHEDULER.md).  ``--init`` seeds a fresh ledger from a slice
+    inventory; ``--submit`` admits a job and places it; with neither,
+    prints the resumed arbiter's status."""
+    from deeplearning_cfn_tpu.sched import FleetArbiter, JobSpec, SchedError
+
+    store = _FileLedger(args.ledger)
+    try:
+        if args.init:
+            inventory = {}
+            for part in args.init.split(","):
+                name, _, chips = part.partition("=")
+                if not name or not chips:
+                    print(f"dlcfn sched: bad --init entry {part!r} "
+                          "(want slice=chips, e.g. s0=4)")
+                    return 2
+                inventory[name.strip()] = int(chips)
+            arbiter = FleetArbiter(inventory, store=store)
+            arbiter.persist()
+        else:
+            arbiter = FleetArbiter.resume(store)
+        if args.submit:
+            arbiter.submit(
+                JobSpec(
+                    name=args.submit,
+                    kind=args.kind,
+                    priority=args.priority,
+                    min_slices=args.min_slices,
+                    max_slices=args.max_slices,
+                )
+            )
+    except SchedError as exc:
+        print(f"dlcfn sched: {exc}")
+        return 2
+    print(json.dumps(arbiter.status(), indent=2, sort_keys=True))
+    return 0
+
+
 def cmd_chaos(args) -> int:
     """dlcfn chaos: run named fault-injection scenarios (docs/RESILIENCE.md).
 
@@ -1708,6 +1769,28 @@ def main(argv: list[str] | None = None) -> int:
     pm.add_argument("-n", "--last", type=int, default=0, dest="last",
                     help="only the last N timeline events (0 = all)")
     pm.set_defaults(fn=cmd_postmortem)
+    ps = sub.add_parser(
+        "sched", help="fleet arbiter: inspect or build the scheduling ledger"
+    )
+    ps.add_argument("--ledger", required=True, type=Path, metavar="PATH",
+                    help="JSON ledger file (file-backed stand-in for the "
+                         "broker KV the production arbiter persists through)")
+    ps.add_argument("--init", default=None, metavar="SPEC",
+                    help="seed a fresh ledger with this slice inventory, "
+                         "e.g. s0=4,s1=4,s2=4 (slice=chips, comma-separated)")
+    ps.add_argument("--submit", default=None, metavar="NAME",
+                    help="admit a job and place it on free slices")
+    ps.add_argument("--kind", default="train", choices=["train", "serve"],
+                    help="job kind for --submit")
+    ps.add_argument("--priority", default="batch",
+                    choices=["prod-serve", "prod-train", "batch"],
+                    help="priority class for --submit")
+    ps.add_argument("--min-slices", type=int, default=1, dest="min_slices",
+                    help="quota floor: fewer than this and the job is "
+                         "unplaced, never partially placed")
+    ps.add_argument("--max-slices", type=int, default=1, dest="max_slices",
+                    help="quota ceiling for opportunistic fill")
+    ps.set_defaults(fn=cmd_sched)
     px = sub.add_parser(
         "chaos", help="run seeded fault-injection scenarios (resilience soak)"
     )
@@ -1715,7 +1798,7 @@ def main(argv: list[str] | None = None) -> int:
                     help="scenario name (see --list): silent-death, "
                          "partition, flaky-rpc, slow-disk, slice-loss-live, "
                          "straggler, serve-replica-loss, broker-failover, "
-                         "split-brain, alert-storm")
+                         "split-brain, alert-storm, sched-flash-crowd")
     px.add_argument("--seed", type=int, default=0,
                     help="fault-schedule seed; reports are deterministic "
                          "per (scenario, seed)")

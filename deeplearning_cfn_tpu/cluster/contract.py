@@ -219,6 +219,48 @@ class ClusterContract:
         contract.tags = dict(self.tags)
         return contract
 
+    def restored(
+        self, regained: dict[str, list[str]], degraded: bool = False
+    ) -> "ClusterContract":
+        """The grow-back derivation — ``surviving()``'s inverse: the same
+        cluster plus slices returning to it (a lent slice coming home
+        after a scheduler preemption resolves, or a reprovisioned slice
+        rejoining).  Goes through :meth:`build` so the ordering
+        invariants (coordinator's slice first, contiguous slices) are
+        re-validated on the grown topology; ``degraded`` defaults to
+        False — a restore is the cluster returning to strength.  Raises
+        ``ValueError`` when there is no slice topology, a regained group
+        is already present, or a regained IP is already a worker.
+        """
+        if not self.slices:
+            raise ValueError(
+                "contract has no slice topology; cannot restore slices into it"
+            )
+        if not regained:
+            raise ValueError("no slices to restore")
+        already = sorted(set(regained) & set(self.slices))
+        if already:
+            raise ValueError(f"slices already present: {already}")
+        merged = {g: list(ips) for g, ips in self.slices.items()}
+        merged.update({g: list(ips) for g, ips in regained.items()})
+        contract = ClusterContract.build(
+            cluster_name=self.cluster_name,
+            coordinator_ip=self.coordinator_ip,
+            other_worker_ips=[
+                ip
+                for ips in merged.values()
+                for ip in ips
+                if ip != self.coordinator_ip
+            ],
+            chips_per_worker=self.chips_per_worker,
+            storage_mount=self.storage_mount,
+            degraded=degraded,
+            slices=merged,
+        )
+        contract.coordinator_port = self.coordinator_port
+        contract.tags = dict(self.tags)
+        return contract
+
     # --- derived views ----------------------------------------------------
     @property
     def workers_count(self) -> int:
@@ -231,6 +273,17 @@ class ClusterContract:
     @property
     def total_chips(self) -> int:
         return self.workers_count * self.chips_per_worker
+
+    def slice_inventory(self) -> dict[str, int]:
+        """Slice name -> chips: the fleet scheduler's placement currency
+        (sched/placer.py).  A single-slice contract exposes its whole
+        capacity under the one name the arbiter can reason about."""
+        if self.slices:
+            return {
+                g: len(ips) * self.chips_per_worker
+                for g, ips in self.slices.items()
+            }
+        return {"all": self.total_chips}
 
     def hostnames(self) -> list[str]:
         # worker0 answers to both names, as in the reference where the master

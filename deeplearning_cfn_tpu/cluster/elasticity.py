@@ -125,6 +125,12 @@ class ElasticityController:
     on_slice_loss: Callable[[str, list[LifecycleEvent]], None] | None = None
     slice_loss_window_s: float = 0.0
     clock: Callable[[], float] = time.monotonic
+    # Hooks run on every flush_slice_losses() call — i.e. at the caller's
+    # safe point (the trainer's step boundary), never inside event
+    # dispatch.  The fleet arbiter (sched/arbiter.py) registers its
+    # reconcile() here so capacity decisions land between steps, with the
+    # same re-entrancy guarantee the slice-loss seam has.
+    safe_point_hooks: list[Callable[[], None]] = field(default_factory=list)
     _debounce: TerminateDebouncer | None = field(default=None, repr=False)
 
     def register(self, policy: GroupPolicy) -> None:
@@ -151,9 +157,10 @@ class ElasticityController:
         elif event.kind is EventKind.TEST_NOTIFICATION:
             log.info("test notification for group %s", event.group)
         elif event.kind is EventKind.ALERT:
-            # SLO alerts (obs/slo.py) share the bus but carry no capacity
-            # intent; the controller only surfaces them.  Autoscale-on-alert
-            # is ROADMAP item 3 and would hook in here.
+            # SLO alerts (obs/slo.py) share the bus; capacity arbitration
+            # on them belongs to the fleet arbiter (sched/arbiter.py),
+            # which subscribes alongside.  The controller only surfaces
+            # them — its job stays per-group lifecycle, not fleet policy.
             log.info(
                 "alert %s for group %s: %s",
                 event.detail.get("state", "?"), event.group, event.detail,
@@ -258,13 +265,21 @@ class ElasticityController:
                 )
             self._debounce.observe(policy.name, event)
 
+    def add_safe_point_hook(self, hook: Callable[[], None]) -> None:
+        """Run ``hook`` at every safe point (see ``safe_point_hooks``)."""
+        self.safe_point_hooks.append(hook)
+
     def flush_slice_losses(self, force: bool = False) -> list[str]:
         """Deliver coalesced slice-loss bursts whose debounce window has
         elapsed (the live-reshard coordinator calls this at each step
-        boundary).  Returns the groups flushed."""
-        if self._debounce is None:
-            return []
-        return [group for group, _ in self._debounce.flush(force=force)]
+        boundary), then run the registered safe-point hooks.  Returns
+        the groups flushed."""
+        flushed: list[str] = []
+        if self._debounce is not None:
+            flushed = [group for group, _ in self._debounce.flush(force=force)]
+        for hook in self.safe_point_hooks:
+            hook()
+        return flushed
 
     def _fire_slice_loss(self, group: str, burst: list[LifecycleEvent]) -> None:
         get_recorder().record(

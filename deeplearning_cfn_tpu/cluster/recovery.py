@@ -103,6 +103,9 @@ class LiveReshardManager:
     contract: ClusterContract
     lost_groups: set[str] = field(default_factory=set)
     events: list[LifecycleEvent] = field(default_factory=list)
+    # Grow direction (the scheduler's restore path): slices armed to
+    # RETURN to the contract at the next step boundary.
+    pending_restores: dict[str, list[str]] = field(default_factory=dict)
 
     def attach(self, controller: ElasticityController) -> None:
         controller.on_slice_loss = self.on_slice_loss
@@ -125,19 +128,48 @@ class LiveReshardManager:
             len(self.lost_groups),
         )
 
+    def arm_restore(self, group: str, ips: list[str]) -> None:
+        """Arm the grow direction: slice ``group`` (with ``ips``) returns
+        to the contract at the next step boundary.  The inverse of
+        ``on_slice_loss``, same safe-point discipline — arming is cheap
+        and idempotent (a slice already in the contract is ignored), the
+        reshard itself happens when the trainer polls.  This is the
+        scheduler's off-peak restore seam (sched/preempt.py)."""
+        slices = self.contract.slices or {}
+        if group in slices:
+            log.info("restore for already-present group %s ignored", group)
+            return
+        self.pending_restores[group] = list(ips)
+        get_recorder().record(
+            "slice_restore_armed", group=group, instances=sorted(ips)
+        )
+        log.warning(
+            "armed for live re-grow: slice %s returning (%d restore(s) pending)",
+            group,
+            len(self.pending_restores),
+        )
+
     @property
     def needs_reshard(self) -> bool:
-        return bool(self.lost_groups)
+        return bool(self.lost_groups or self.pending_restores)
 
     def surviving_contract(self) -> ClusterContract:
-        """Raises ValueError when live reshard is structurally impossible
-        (e.g. the coordinator's slice died) — see ClusterContract.surviving."""
-        return self.contract.surviving(self.lost_groups)
+        """The target topology: survivors of any lost slices, plus any
+        armed restores (``ClusterContract.restored``).  Raises ValueError
+        when live reshard is structurally impossible (e.g. the
+        coordinator's slice died) — see ClusterContract.surviving."""
+        contract = self.contract
+        if self.lost_groups:
+            contract = contract.surviving(self.lost_groups)
+        if self.pending_restores:
+            contract = contract.restored(self.pending_restores)
+        return contract
 
     def commit(self, contract: ClusterContract) -> None:
         self.contract = contract
         self.lost_groups.clear()
         self.events.clear()
+        self.pending_restores.clear()
 
 
 def run_with_recovery(

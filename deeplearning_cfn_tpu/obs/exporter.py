@@ -78,6 +78,13 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
     "dlcfn_datastream_checkpoint_write_seconds": ("gauge", "Off-path seconds the background writer spent on the last sharded checkpoint."),
     "dlcfn_datastream_checkpoint_writes_total": ("counter", "Async sharded checkpoint manifests committed."),
     "dlcfn_datastream_native_fallback_total": ("counter", "Record-loader falls from native to the pure-Python reader."),
+    # fleet scheduler (sched/arbiter.py, docs/SCHEDULER.md)
+    "dlcfn_sched_jobs": ("gauge", "Jobs admitted to the fleet arbiter."),
+    "dlcfn_sched_slices_free": ("gauge", "Slices in the inventory not assigned to any job."),
+    "dlcfn_sched_loans_outstanding": ("gauge", "Slices currently lent from a preempted job to the serve pool."),
+    "dlcfn_sched_decisions_total": ("counter", "Arbiter decisions journaled (submit/preempt/restore/absorb/defer)."),
+    "dlcfn_sched_preemptions_total": ("counter", "Slices preempted from a lower-priority job under a serve page."),
+    "dlcfn_sched_restores_total": ("counter", "Lent slices returned to their owning job after the page resolved."),
     # fleet telemetry (TELEM plane, obs/aggregator.py)
     "dlcfn_fleet_workers": ("gauge", "Workers with a fresh telemetry snapshot in the fleet merge."),
     "dlcfn_fleet_telemetry_age_seconds": ("gauge", "Age of each worker's newest telemetry snapshot."),
@@ -285,6 +292,42 @@ def fold_datastream_events(events) -> dict[str, Any]:
     return out if saw else {}
 
 
+def fold_sched_events(events) -> dict[str, Any]:
+    """Fold flight-journal scheduler events (``sched_decision`` /
+    ``sched_preempt`` / ``sched_restore``) into the counters the
+    ``dlcfn_sched_*`` families surface.  Decisions carry the arbiter's
+    fleet shape (jobs, free slices), preempts/restores carry the loan
+    book — last-wins for the gauges, counting for the totals.  Empty
+    dict when the arbiter never journaled."""
+    out: dict[str, Any] = {
+        "decisions": 0,
+        "preemptions": 0,
+        "restores": 0,
+        "jobs": None,
+        "free_slices": None,
+        "loans_outstanding": None,
+        "last": None,
+    }
+    saw = False
+    for event in events:
+        kind = event.get("kind")
+        if kind == "sched_decision":
+            saw = True
+            out["decisions"] += 1
+            out["jobs"] = event.get("jobs")
+            out["free_slices"] = event.get("free_slices")
+            out["loans_outstanding"] = event.get("loans_outstanding")
+        elif kind in ("sched_preempt", "sched_restore"):
+            saw = True
+            out["preemptions" if kind == "sched_preempt" else "restores"] += 1
+            out["loans_outstanding"] = event.get("loans_outstanding")
+            out["last"] = {
+                k: event.get(k)
+                for k in ("kind", "seq", "rule", "slice", "from_job", "to_job")
+            }
+    return out if saw else {}
+
+
 def render_prometheus(
     liveness: Mapping[str, Mapping[str, Any]] | None = None,
     spans: Mapping[str, Mapping[str, Any]] | None = None,
@@ -298,6 +341,7 @@ def render_prometheus(
     comms: Mapping[str, Mapping[str, Any]] | None = None,
     fleet: Mapping[str, Any] | None = None,
     datastream: Mapping[str, Any] | None = None,
+    sched: Mapping[str, Any] | None = None,
 ) -> str:
     """Render liveness snapshot + span aggregates + input-pipeline
     counters as Prometheus text.
@@ -318,7 +362,9 @@ def render_prometheus(
     ``fleet`` is ``obs.aggregator.FleetAggregator.merge()`` (the TELEM
     fleet merge); ``datastream`` is ``fold_datastream_events()`` (the
     sharded streaming data plane's progress/reshard/async-checkpoint
-    counters).  Any may be None/empty.
+    counters); ``sched`` is ``fold_sched_events()`` (the fleet
+    arbiter's decision/preemption/loan counters).  Any may be
+    None/empty.
     """
     lines: list[str] = []
     seen: set[str] = set()
@@ -671,4 +717,22 @@ def render_prometheus(
                 f"dlcfn_datastream_native_fallback_total"
                 f"{_labels(cluster=cluster)} {datastream['native_fallback_total']}"
             )
+    if sched:
+        for name, key in (
+            ("dlcfn_sched_jobs", "jobs"),
+            ("dlcfn_sched_slices_free", "free_slices"),
+            ("dlcfn_sched_loans_outstanding", "loans_outstanding"),
+        ):
+            value = sched.get(key)
+            if value is None:
+                continue
+            head(name)
+            lines.append(f"{name}{_labels(cluster=cluster)} {value}")
+        for name, key in (
+            ("dlcfn_sched_decisions_total", "decisions"),
+            ("dlcfn_sched_preemptions_total", "preemptions"),
+            ("dlcfn_sched_restores_total", "restores"),
+        ):
+            head(name)
+            lines.append(f"{name}{_labels(cluster=cluster)} {sched.get(key, 0)}")
     return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,36 @@
+"""Multi-tenant fleet scheduling: one cluster arbitrating many jobs.
+
+The policy layer over the mechanisms PRs 6-15 built: job specs with
+priority classes and slice quotas (:mod:`~deeplearning_cfn_tpu.sched.specs`),
+a deterministic bin-packing placer
+(:mod:`~deeplearning_cfn_tpu.sched.placer`), the alert-driven arbiter
+with its broker-persisted ledger
+(:mod:`~deeplearning_cfn_tpu.sched.arbiter`), and the preemption driver
+that turns decisions into live reshards and serve-pool resizes
+(:mod:`~deeplearning_cfn_tpu.sched.preempt`).  docs/SCHEDULER.md is the
+operator-facing tour; ``dlcfn chaos --scenario sched-flash-crowd`` is
+the gate.
+"""
+
+from deeplearning_cfn_tpu.sched.arbiter import (  # noqa: F401
+    DEFAULT_SERVE_RULES,
+    LEDGER_KEY,
+    FleetArbiter,
+    SchedError,
+)
+from deeplearning_cfn_tpu.sched.placer import (  # noqa: F401
+    Placement,
+    place,
+    verify_placement,
+)
+from deeplearning_cfn_tpu.sched.preempt import (  # noqa: F401
+    PreemptionDriver,
+    ServePoolHandle,
+    TrainJobHandle,
+)
+from deeplearning_cfn_tpu.sched.specs import (  # noqa: F401
+    JOB_KINDS,
+    PRIORITY_CLASSES,
+    JobSpec,
+    priority_rank,
+)

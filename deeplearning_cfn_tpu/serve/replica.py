@@ -186,6 +186,62 @@ class ServeFrontEnd:
         )
         return len(orphans)
 
+    # --- pool resize (scheduler seam) -----------------------------------
+    def add_replica(self, replica: ServeReplica) -> None:
+        """Grow the pool: a lent slice's replica joins the router.  The
+        fleet arbiter (sched/preempt.py) calls this when a preempted
+        train slice is lent to the serve pool during a flash crowd."""
+        if replica.name in self.replicas:
+            raise ValueError(f"replica {replica.name} already in pool")
+        self.replicas[replica.name] = replica
+        get_recorder().record(
+            "serve_pool_resize",
+            action="add",
+            replica=replica.name,
+            pool=sorted(self.replicas),
+        )
+        log.info("replica %s joined pool (%s)", replica.name, sorted(self.replicas))
+
+    def retire_replica(self, name: str, force: bool = False) -> ServeReplica | None:
+        """Shrink the pool: remove ``name`` gracefully.  Unlike
+        ``fail_replica`` the replica is healthy — by default retirement
+        is refused (returns None) while it still holds in-flight work;
+        with ``force`` the in-flight requests are replayed onto the
+        survivors first (same durability contract as failover), which is
+        what the arbiter uses to reclaim a lent slice off-peak."""
+        replica = self.replicas.get(name)
+        if replica is None:
+            return None
+        orphans = replica.engine.inflight_requests()
+        if orphans and not force:
+            return None
+        del self.replicas[name]
+        for req in orphans:
+            fresh = ServeRequest(
+                request_id=req.request_id,
+                prompt=req.prompt,
+                max_new_tokens=req.max_new_tokens,
+                arrival_s=req.arrival_s,
+            )
+            survivor = self._pick()
+            survivor.submit(fresh, arrival_s=req.arrival_s)
+            self.assignment[req.request_id] = survivor.name
+            self.replayed.append(req.request_id)
+        get_recorder().record(
+            "serve_pool_resize",
+            action="retire",
+            replica=name,
+            replayed=len(orphans),
+            pool=sorted(self.replicas),
+        )
+        log.info(
+            "replica %s retired (replayed %d); pool now %s",
+            name,
+            len(orphans),
+            sorted(self.replicas),
+        )
+        return replica
+
     def on_instance_loss(self, policy, event) -> None:
         """ElasticityController ``on_instance_loss`` seam adapter: an
         ``INSTANCE_TERMINATE`` for ``serve/<name>`` fails that replica."""

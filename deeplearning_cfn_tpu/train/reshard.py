@@ -90,7 +90,7 @@ def state_shardings_for(trainer: Any, state: TrainState, mesh: Mesh) -> TrainSta
             )
         except (KeyError, ValueError, TypeError) as exc:
             raise ReshardError(
-                f"explicit param shardings do not map onto the surviving mesh: {exc}"
+                f"explicit param shardings do not map onto the target mesh: {exc}"
             ) from exc
     elif trainer.config.strategy == "fsdp":
         param_sh = infer_param_sharding(abstract_params, mesh)
@@ -130,7 +130,7 @@ def ensure_hostable(state: Any, shardings: Any) -> None:
                 raise ReshardError(
                     f"leaf {jax.tree_util.keystr(path)} shape "
                     f"{tuple(getattr(x, 'shape', ()))} dim {dim} not divisible "
-                    f"by {n} on the surviving mesh"
+                    f"by {n} on the target mesh"
                 )
 
     jax.tree_util.tree_map_with_path(check, state, shardings)
@@ -148,18 +148,32 @@ def migrate_state(state: TrainState, shardings: TrainState) -> TrainState:
     )
 
 
-def rescale_grad_accum(accum: int, old_devices: int, new_devices: int) -> int:
+def rescale_grad_accum(
+    accum: int, old_devices: int, new_devices: int, *, symmetric: bool = False
+) -> int:
     """Grad-accumulation count that preserves the global batch on a
     smaller mesh while keeping the per-device microbatch footprint no
     larger than before: the same global batch now lands on fewer devices,
     so each device sees ``old/new`` times more examples per step — split
     the step into proportionally more microbatches.  8→4 devices at
-    accum=1 becomes accum=2; a grown mesh never *reduces* accum (that
-    would change a tuning choice behind the caller's back)."""
+    accum=1 becomes accum=2.
+
+    By default a grown mesh never *reduces* accum (that would change a
+    tuning choice behind the caller's back).  ``symmetric=True`` is the
+    scheduler's restore mode (sched/preempt.py): growth inverts the
+    shrink scaling exactly, so a preempt-then-restore round trip lands
+    back on the original accum — 8→4 takes 1 to 2, 4→8 takes 2 back to
+    1 — and only when the inversion is exact; a non-integral inverse
+    keeps the current accum rather than perturb the global batch."""
     if new_devices <= 0:
         raise ReshardError("surviving mesh has no devices")
     if new_devices >= old_devices:
-        return accum
+        if not symmetric or new_devices == old_devices:
+            return accum
+        scaled, rem = divmod(accum * old_devices, new_devices)
+        if rem:
+            return accum
+        return max(1, scaled)
     return max(1, math.ceil(accum * old_devices / new_devices))
 
 
@@ -207,6 +221,12 @@ class LiveReshardCoordinator:
     #: over the survivors at the same step boundary the mesh is
     #: (train/datastream.DataStreamPlane, docs/DATA.md).
     on_commit: Callable[[Any], Any] | None = None
+    #: Scheduler mode (sched/preempt.py): grad-accum rescale inverts
+    #: exactly on a grown mesh, so a preempt-then-restore round trip
+    #: returns accum to its pre-preempt value (bit-safe restore).  Off
+    #: by default — a plain slice-loss reshard keeps the conservative
+    #: never-shrink-on-grow behavior.
+    symmetric_accum: bool = False
 
     @property
     def live_total(self) -> int:
@@ -241,7 +261,12 @@ class LiveReshardCoordinator:
             ensure_hostable(state, shardings)
             with span("reshard", step=step):
                 new_state = migrate_state(state, shardings)
-            new_accum = rescale_grad_accum(old_accum, old_devices, int(new_mesh.size))
+            new_accum = rescale_grad_accum(
+                old_accum,
+                old_devices,
+                int(new_mesh.size),
+                symmetric=self.symmetric_accum,
+            )
             trainer.config.grad_accum_steps = new_accum
             trainer.rebind_mesh(new_mesh, shardings)
             self.manager.commit(contract)

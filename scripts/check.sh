@@ -34,16 +34,19 @@ python - <<'EOF' || exit 1
 # dynamic, so pin the serving SLO scenario, the control-plane failover
 # pair (broker-failover's 1k-agent soak, split-brain's epoch fencing),
 # the telemetry/alerting gate (alert-storm: exactly-once alerts
-# through silent deaths, stragglers, and a broker failover), and the
+# through silent deaths, stragglers, and a broker failover), the
 # data-plane gate (data-reshard-live: live reshard mid-epoch over real
 # record shards, every record exactly once, bit-identical resume from
-# the v3 envelope).
+# the v3 envelope), and the multi-tenancy gate (sched-flash-crowd:
+# the fleet arbiter preempts/restores a train slice under a serve page
+# with loss continuity, zero lost requests, and crash-safe ledger
+# resume — docs/SCHEDULER.md).
 import json
 reports = json.load(open("/tmp/_chaos.json"))
 names = {r["scenario"] for r in reports}
 for required in ("serve-replica-loss", "broker-failover", "split-brain",
                  "shard-failover", "degraded-pair-heal",
-                 "alert-storm", "data-reshard-live"):
+                 "alert-storm", "data-reshard-live", "sched-flash-crowd"):
     assert required in names, f"{required} missing from {sorted(names)}"
 EOF
 echo "chaos: all scenarios held their invariants (report: /tmp/_chaos.json)"

@@ -20,9 +20,11 @@ and the consumer holds its single post-warmup compile), a datastream
 stage (per-host shard assignment is an exact partition, one epoch reads
 every record exactly once, and the async sharded checkpointer's save()
 provably never blocks a step — its writer is parked on a gate while the
-step path keeps enqueuing), and an exact-match check of the audited
-train step's collective bytes against the committed comms budget
-(8-virtual-device runs only) ride along.
+step path keeps enqueuing), a fleet-scheduler stage (placement is a
+deterministic pure function under permuted submission, quota invariants
+hold, and the sched package never reads the wall clock), and an
+exact-match check of the audited train step's collective bytes against
+the committed comms budget (8-virtual-device runs only) ride along.
 
 Exit 0 and one JSON line on success; exit 1 with a message on violation.
 """
@@ -731,6 +733,87 @@ def fleet_sim() -> tuple[dict, list[str]]:
     return first, failures
 
 
+SCHED_JOBS = 6
+SCHED_SLICES = 5
+
+
+def sched_placer() -> tuple[dict, list[str]]:
+    """Fleet-scheduler stage: structural asserts only, no wall-clock.
+
+    Checks the placer's contracts (docs/SCHEDULER.md): (1) placement is
+    a deterministic pure function — repeated calls AND permuted
+    submission orders produce byte-identical placements; (2) quota
+    invariants hold by verify_placement (each slice assigned at most
+    once, every placed job within [min_slices, max_slices], every job
+    placed or carrying a reason); (3) the sched package never touches
+    the wall clock — all of its timing flows through the injected
+    broker/journal seams, so decisions replay deterministically."""
+    import itertools
+
+    from deeplearning_cfn_tpu.sched import JobSpec, place, verify_placement
+
+    failures: list[str] = []
+    inventory = {f"s{i}": 4 for i in range(SCHED_SLICES)}
+    jobs = [
+        JobSpec(name="chat", kind="serve", priority="prod-serve"),
+        JobSpec(name="train-a", kind="train", priority="prod-train",
+                min_slices=1, max_slices=2),
+        JobSpec(name="train-b", kind="train", priority="prod-train",
+                min_slices=2, max_slices=2),
+        JobSpec(name="nightly", kind="train", priority="batch",
+                min_slices=1, max_slices=3),
+        JobSpec(name="eval", kind="serve", priority="batch"),
+        JobSpec(name="hopeless", kind="train", priority="batch",
+                min_slices=SCHED_SLICES + 1, max_slices=SCHED_SLICES + 1),
+    ]
+    assert len(jobs) == SCHED_JOBS
+    baseline = place(jobs, inventory)
+    for trial, ordering in enumerate(itertools.permutations(jobs, len(jobs))):
+        if trial >= 24:  # two dozen permutations is plenty of shuffle
+            break
+        if place(list(ordering), inventory).to_dict() != baseline.to_dict():
+            failures.append(
+                f"placement depends on submission order (permutation {trial})"
+            )
+            break
+    quota_errors = verify_placement(baseline, jobs, inventory)
+    failures.extend(f"quota invariant: {e}" for e in quota_errors)
+    if "hopeless" not in baseline.unplaced:
+        failures.append(
+            "over-quota job was placed instead of explained in unplaced"
+        )
+    if baseline.assignments.get("chat") != ("s0",):
+        failures.append(
+            f"prod-serve did not get the first slice: {baseline.assignments}"
+        )
+    # No wall clock anywhere in the package: a sched decision must be a
+    # pure function of (ledger, intents), or crash-resume cannot replay.
+    sched_dir = Path(__file__).resolve().parent.parent / (
+        "deeplearning_cfn_tpu/sched"
+    )
+    clocked = [
+        p.name
+        for p in sorted(sched_dir.glob("*.py"))
+        if any(
+            probe in p.read_text()
+            for probe in ("time.time(", "time.monotonic(", "time.sleep(")
+        )
+    ]
+    if clocked:
+        failures.append(
+            f"sched package touches the wall clock in {clocked} — "
+            "decisions must be replayable from the ledger alone"
+        )
+    return {
+        "jobs": SCHED_JOBS,
+        "slices": SCHED_SLICES,
+        "assignments": {j: list(s) for j, s in sorted(baseline.assignments.items())},
+        "unplaced": dict(sorted(baseline.unplaced.items())),
+        "permutations_checked": 24,
+        "quota_errors": len(quota_errors),
+    }, failures
+
+
 def main() -> int:
     u8_snap, u8_x = run_pipeline("uint8")
     f32_snap, f32_x = run_pipeline("float32")
@@ -827,6 +910,9 @@ def main() -> int:
     datastream_snap, datastream_failures = datastream()
     failures.extend(datastream_failures)
 
+    sched_snap, sched_failures = sched_placer()
+    failures.extend(sched_failures)
+
     comms_snap, comms_failures = comms_budget()
     failures.extend(comms_failures)
 
@@ -854,6 +940,7 @@ def main() -> int:
                 "fleet_sim": fleet_snap,
                 "telemetry": telem_snap,
                 "datastream": datastream_snap,
+                "sched": sched_snap,
                 "comms": comms_snap,
             },
             allow_nan=False,

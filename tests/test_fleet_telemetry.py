@@ -31,7 +31,11 @@ from deeplearning_cfn_tpu.obs.blackbox import (
     render_timeline,
     write_bundle,
 )
-from deeplearning_cfn_tpu.obs.exporter import METRIC_REGISTRY, render_prometheus
+from deeplearning_cfn_tpu.obs.exporter import (
+    METRIC_REGISTRY,
+    fold_sched_events,
+    render_prometheus,
+)
 from deeplearning_cfn_tpu.obs.recorder import FlightRecorder
 from deeplearning_cfn_tpu.obs.slo import (
     DEFAULT_RULES,
@@ -315,19 +319,28 @@ def test_metric_registry_names_types_and_help_are_well_formed():
 
 def test_render_never_duplicates_type_headers_across_folds():
     """Overlapping sections (fleet dead_fraction + liveness families,
-    spans + profiler summaries) must share one header per family."""
+    spans + profiler summaries, the sched arbiter fold) must share one
+    header per family."""
     liveness = {"g/0": {"state": "alive", "age_s": 1.0, "beats": 3}}
     fleet = FleetAggregator().merge(
         {"g/0": (1.0, 3, _payload({"dlcfn_serve_queue_depth": 2.0},
                                   {"dlcfn_step_ms": [10.0, 20.0]}))},
         liveness={"g/0": {"state": "alive"}},
     )
+    sched = fold_sched_events([
+        {"kind": "sched_decision", "action": "submit", "jobs": 2,
+         "free_slices": 1, "loans_outstanding": 0},
+        {"kind": "sched_preempt", "seq": 1, "rule": "serve-queue-depth",
+         "slice": "s2", "from_job": "train", "to_job": "chat",
+         "loans_outstanding": 1},
+    ])
     text = render_prometheus(
         liveness=liveness,
         spans={"step": {"count": 2, "total_s": 1.0, "max_s": 0.6,
                         "p50_s": 0.5, "p95_s": 0.6, "p99_s": 0.6}},
         cluster="c1",
         fleet=fleet,
+        sched=sched,
     )
     type_lines = [l for l in text.splitlines() if l.startswith("# TYPE ")]
     families = [l.split()[2] for l in type_lines]

@@ -1,0 +1,618 @@
+"""Unit tests for the fleet scheduler (sched/): specs, placer, arbiter,
+preemption driver seams, and the sched telemetry fold.
+
+The chaos gate (``dlcfn chaos --scenario sched-flash-crowd``) proves the
+whole loop against a live SPMD trainer; these tests pin each layer in
+isolation — placement determinism, quota enforcement, exactly-once alert
+consumption, ledger crash-resume without a repeated preemption, and the
+bit-safe grad-accum round trip the restore path depends on.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+
+import pytest
+
+from deeplearning_cfn_tpu.analysis.schedules import VirtualClock
+from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+from deeplearning_cfn_tpu.obs.recorder import configure, get_recorder
+from deeplearning_cfn_tpu.obs.slo import SloEngine, SloRule
+from deeplearning_cfn_tpu.provision.events import (
+    EventBus,
+    EventKind,
+    LifecycleEvent,
+)
+from deeplearning_cfn_tpu.sched import (
+    DEFAULT_SERVE_RULES,
+    LEDGER_KEY,
+    FleetArbiter,
+    JobSpec,
+    PreemptionDriver,
+    SchedError,
+    ServePoolHandle,
+    TrainJobHandle,
+    place,
+    priority_rank,
+    verify_placement,
+)
+from deeplearning_cfn_tpu.train.reshard import rescale_grad_accum
+
+
+@pytest.fixture()
+def recorder():
+    """A fresh process-wide flight recorder, so journal-count assertions
+    never see another test's events."""
+    return configure()
+
+
+# --- specs ------------------------------------------------------------------
+
+
+def test_jobspec_validate_catches_schema_errors():
+    good = JobSpec(name="a", kind="train")
+    assert good.validate() == []
+    errors = JobSpec(
+        name="", kind="cron", priority="best-effort", min_slices=0, max_slices=-1
+    ).validate()
+    text = "; ".join(errors)
+    assert "no name" in text
+    assert "unknown kind" in text
+    assert "unknown priority" in text
+    assert "min_slices" in text
+    # max < min is implied by (0, -1) once min is clamped in the message
+    assert "max_slices" in text
+
+
+def test_priority_ladder_and_preemptibility():
+    assert priority_rank("prod-serve") < priority_rank("prod-train")
+    assert priority_rank("prod-train") < priority_rank("batch")
+    with pytest.raises(ValueError, match="unknown priority"):
+        priority_rank("platinum")
+    assert not JobSpec(name="s", kind="serve", priority="prod-serve").preemptible
+    assert JobSpec(name="t", kind="train", priority="prod-train").preemptible
+    assert JobSpec(name="b", kind="train", priority="batch").preemptible
+
+
+def test_jobspec_dict_roundtrip():
+    spec = JobSpec(
+        name="t", kind="train", priority="prod-train",
+        min_slices=1, max_slices=3, tags={"team": "ml"},
+    )
+    assert JobSpec.from_dict(spec.to_dict()) == spec
+
+
+# --- placer -----------------------------------------------------------------
+
+INVENTORY = {"s0": 4, "s1": 4, "s2": 4, "s3": 4}
+
+
+def _jobs():
+    return [
+        JobSpec(name="chat", kind="serve", priority="prod-serve"),
+        JobSpec(name="train", kind="train", priority="prod-train",
+                min_slices=1, max_slices=3),
+        JobSpec(name="nightly", kind="train", priority="batch",
+                min_slices=1, max_slices=2),
+    ]
+
+
+def test_place_floor_then_round_robin_fill():
+    verdict = place(_jobs(), INVENTORY)
+    # Floors: chat->s0, train->s1, nightly->s2.  Fill deals the one
+    # remaining slice round-robin in priority order: train gets s3 —
+    # but only after every under-ceiling job saw the round, so nightly
+    # is not starved when two slices remain.
+    assert verdict.assignments == {
+        "chat": ("s0",), "train": ("s1", "s3"), "nightly": ("s2",),
+    }
+    assert verdict.unplaced == {}
+    assert verify_placement(verdict, _jobs(), INVENTORY) == []
+
+
+def test_place_round_robin_does_not_starve_lower_class():
+    inventory = {"s0": 4, "s1": 4, "s2": 4, "s3": 4}
+    jobs = [
+        JobSpec(name="big", kind="train", priority="prod-train",
+                min_slices=1, max_slices=4),
+        JobSpec(name="small", kind="train", priority="batch",
+                min_slices=1, max_slices=2),
+    ]
+    verdict = place(jobs, inventory)
+    # One fill slice each per round: big cannot take both spares.
+    assert verdict.assignments["small"] == ("s1", "s3")
+    assert verdict.assignments["big"] == ("s0", "s2")
+
+
+def test_place_is_invariant_under_submission_order():
+    baseline = place(_jobs(), INVENTORY).to_dict()
+    for perm in itertools.permutations(_jobs()):
+        assert place(perm, INVENTORY).to_dict() == baseline
+
+
+def test_place_floor_is_all_or_nothing():
+    jobs = [JobSpec(name="wide", kind="train", min_slices=3, max_slices=3)]
+    verdict = place(jobs, {"s0": 4, "s1": 4})
+    assert verdict.assignments == {}
+    assert "needs 3 slice(s), only 2 free" in verdict.unplaced["wide"]
+    assert verify_placement(verdict, jobs, {"s0": 4, "s1": 4}) == []
+
+
+def test_place_prefers_bigger_slices_for_higher_class():
+    inventory = {"tiny": 2, "big": 8}
+    verdict = place(_jobs()[:2], inventory)
+    assert verdict.assignments["chat"] == ("big",)
+    assert verdict.assignments["train"] == ("tiny",)
+
+
+def test_place_pinned_assignments_are_sticky():
+    jobs = _jobs()
+    pinned = {"train": ("s3",)}
+    verdict = place(jobs, INVENTORY, pinned=pinned)
+    # The running assignment survives as the base (fill may still grow
+    # the job toward its ceiling — growth is not a migration).
+    assert verdict.assignments["train"][0] == "s3"
+    # Pinned slice withheld from the free pool.
+    taken = [s for slices in verdict.assignments.values() for s in slices]
+    assert len(taken) == len(set(taken))
+    with pytest.raises(ValueError, match="not in the inventory"):
+        place(jobs, INVENTORY, pinned={"train": ("mars",)})
+    with pytest.raises(ValueError, match="more than one job"):
+        place(jobs, INVENTORY, pinned={"train": ("s0",), "chat": ("s0",)})
+
+
+def test_place_duplicate_job_names_raise():
+    twice = [JobSpec(name="x", kind="train"), JobSpec(name="x", kind="serve")]
+    with pytest.raises(ValueError, match="duplicate job names"):
+        place(twice, INVENTORY)
+
+
+def test_verify_placement_flags_violations():
+    jobs = _jobs()
+    verdict = place(jobs, INVENTORY)
+    verdict.assignments["chat"] = ("s1", "ghost")  # double + unknown + quota
+    errors = "; ".join(verify_placement(verdict, jobs, INVENTORY))
+    assert "unknown slice 'ghost'" in errors
+    assert "assigned to both" in errors
+    assert "outside quota" in errors
+    verdict2 = place(jobs, INVENTORY)
+    del verdict2.assignments["nightly"]
+    assert any(
+        "neither placed nor explained" in e
+        for e in verify_placement(verdict2, jobs, INVENTORY)
+    )
+
+
+# --- arbiter: admission and ledger ------------------------------------------
+
+
+class _Store:
+    def __init__(self):
+        self.table: dict[str, str] = {}
+
+    def set(self, key, value):
+        self.table[key] = value
+
+    def get(self, key):
+        return self.table.get(key)
+
+
+def _arbiter(store=None, driver=None):
+    return FleetArbiter(
+        inventory={"s0": 4, "s1": 4, "s2": 4},
+        slice_ips={"s0": ["10.0.0.1"], "s1": ["10.0.0.2"], "s2": ["10.0.0.3"]},
+        store=store,
+        driver=driver,
+    )
+
+
+def test_submit_places_on_free_slices_and_is_sticky(recorder):
+    arbiter = _arbiter()
+    assert arbiter.submit(
+        JobSpec(name="nightly", kind="train", priority="batch",
+                min_slices=1, max_slices=2)
+    ) == ("s0", "s1")
+    # A later, higher-priority job only sees what is left: admission
+    # never migrates a running job.
+    assert arbiter.submit(
+        JobSpec(name="chat", kind="serve", priority="prod-serve")
+    ) == ("s2",)
+    assert arbiter.free_slices() == []
+    status = arbiter.status()
+    assert status["assignments"]["nightly"] == ["s0", "s1"]
+    assert status["counters"]["decisions"] == 2
+    kinds = [e["kind"] for e in recorder.tail(10)]
+    assert kinds.count("sched_decision") == 2
+
+
+def test_submit_rejects_invalid_and_duplicate_specs():
+    arbiter = _arbiter()
+    with pytest.raises(SchedError, match="unknown priority"):
+        arbiter.submit(JobSpec(name="x", kind="train", priority="gold"))
+    arbiter.submit(JobSpec(name="x", kind="train"))
+    with pytest.raises(SchedError, match="already submitted"):
+        arbiter.submit(JobSpec(name="x", kind="train"))
+
+
+def test_submit_unplaced_job_is_admitted_with_reason():
+    arbiter = _arbiter()
+    assert arbiter.submit(
+        JobSpec(name="wide", kind="train", min_slices=9, max_slices=9)
+    ) == ()
+    assert "only 3 free" in arbiter.status()["unplaced"]["wide"]
+
+
+def test_from_contract_uses_slice_inventory():
+    contract = ClusterContract.build(
+        cluster_name="c",
+        coordinator_ip="10.0.0.1",
+        other_worker_ips=["10.0.0.2", "10.0.0.3", "10.0.0.4"],
+        chips_per_worker=2,
+        storage_mount="/mnt",
+        slices={"s0": ["10.0.0.1", "10.0.0.2"], "s1": ["10.0.0.3", "10.0.0.4"]},
+    )
+    arbiter = FleetArbiter.from_contract(contract)
+    assert arbiter.inventory == {"s0": 4, "s1": 4}
+    assert arbiter.slice_ips["s1"] == ["10.0.0.3", "10.0.0.4"]
+
+
+def test_ledger_persists_every_mutation_and_resumes(recorder):
+    store = _Store()
+    arbiter = _arbiter(store=store)
+    arbiter.submit(JobSpec(name="chat", kind="serve", priority="prod-serve"))
+    arbiter.submit(
+        JobSpec(name="train", kind="train", priority="prod-train",
+                min_slices=1, max_slices=2)
+    )
+    body = json.loads(store.table[LEDGER_KEY])
+    assert body["assignments"]["train"] == ["s1", "s2"]
+    resumed = FleetArbiter.resume(store)
+    assert resumed.ledger() == arbiter.ledger()
+    assert resumed.jobs["chat"].priority == "prod-serve"
+    assert resumed.serve_rules == DEFAULT_SERVE_RULES
+
+
+def test_resume_without_ledger_raises():
+    with pytest.raises(SchedError, match="no ledger"):
+        FleetArbiter.resume(_Store())
+
+
+# --- arbiter: alert intake ---------------------------------------------------
+
+
+def _alert(rule, state, value=20.0):
+    return LifecycleEvent(
+        kind=EventKind.ALERT,
+        group="fleet",
+        detail={"rule": rule, "state": state, "value": value, "severity": "page"},
+    )
+
+
+def test_on_event_filters_kind_rule_and_state(recorder):
+    arbiter = _arbiter()
+    bus = EventBus()
+    arbiter.attach(bus)
+    bus.publish(LifecycleEvent(kind=EventKind.INSTANCE_TERMINATE, group="g"))
+    bus.publish(_alert("train-step-slow", "firing"))  # not a serve rule
+    bus.publish(_alert("serve-queue-depth", "pending"))  # not a transition
+    assert arbiter.alert_counts == {}
+    assert arbiter.pending_pages == []
+    bus.publish(_alert("serve-queue-depth", "firing"))
+    bus.publish(_alert("serve-queue-depth", "resolved"))
+    assert arbiter.alert_counts["serve-queue-depth"] == {
+        "firing": 1, "resolved": 1,
+    }
+    arbiter.detach(bus)
+    bus.publish(_alert("serve-queue-depth", "firing"))
+    assert arbiter.alert_counts["serve-queue-depth"]["firing"] == 1
+
+
+def test_alert_reaches_subscriber_exactly_once_per_transition(recorder):
+    """Satellite pin: one SLO breach window produces exactly ONE firing
+    delivery and one resolved delivery to each subscriber, no matter how
+    many evaluation ticks the breach spans."""
+    clock = VirtualClock()
+    bus = EventBus()
+    seen: list[tuple[str, str]] = []
+    bus.subscribe(
+        lambda e: seen.append((e.detail["rule"], e.detail["state"]))
+        if e.kind is EventKind.ALERT
+        else None
+    )
+    arbiter = _arbiter()
+    arbiter.attach(bus)
+    rule = SloRule(
+        name="serve-queue-depth", metric="dlcfn_serve_queue_depth",
+        agg="sum", op=">", threshold=10.0, for_s=2.0, severity="page",
+    )
+    engine = SloEngine(rules=(rule,), clock=clock, bus=bus)
+    for depth in (20.0, 20.0, 20.0, 20.0, 20.0, 4.0, 4.0):
+        engine.evaluate({"dlcfn_serve_queue_depth": {"sum": depth}})
+        clock.advance(1.0)
+    assert seen == [
+        ("serve-queue-depth", "firing"), ("serve-queue-depth", "resolved"),
+    ]
+    assert arbiter.alert_counts["serve-queue-depth"] == {
+        "firing": 1, "resolved": 1,
+    }
+    assert len(arbiter.pending_pages) == 1
+    assert len(arbiter.pending_resolves) == 1
+
+
+# --- arbiter: reconcile (preempt / restore / absorb / defer) -----------------
+
+
+class _FakeManager:
+    def __init__(self):
+        self.lost: list[tuple[str, int]] = []
+        self.restored: list[tuple[str, list[str]]] = []
+
+    def on_slice_loss(self, group, events):
+        self.lost.append((group, len(events)))
+
+    def arm_restore(self, group, ips):
+        self.restored.append((group, list(ips)))
+
+
+class _FakeEngine:
+    def __init__(self, inflight=()):
+        self._inflight = list(inflight)
+
+    def inflight_requests(self):
+        return list(self._inflight)
+
+
+class _FakeReplica:
+    def __init__(self, name):
+        self.name = name
+        self.engine = _FakeEngine()
+
+
+class _FakeFrontEnd:
+    def __init__(self):
+        self.replicas: dict[str, _FakeReplica] = {}
+
+    def add_replica(self, replica):
+        self.replicas[replica.name] = replica
+
+    def retire_replica(self, name, force=False):
+        return self.replicas.pop(name, None)
+
+
+def _wired_arbiter(store=None):
+    manager = _FakeManager()
+    frontend = _FakeFrontEnd()
+    driver = PreemptionDriver()
+    driver.register_train("train", TrainJobHandle(manager=manager))
+    driver.register_serve(
+        "chat", ServePoolHandle(frontend=frontend, spawn=_FakeReplica)
+    )
+    arbiter = _arbiter(store=store, driver=driver)
+    arbiter.submit(JobSpec(name="chat", kind="serve", priority="prod-serve"))
+    arbiter.submit(
+        JobSpec(name="train", kind="train", priority="prod-train",
+                min_slices=1, max_slices=2)
+    )
+    return arbiter, manager, frontend, driver
+
+
+def test_reconcile_preempts_then_restores(recorder):
+    arbiter, manager, frontend, driver = _wired_arbiter()
+    assert arbiter.assignments == {"chat": ["s0"], "train": ["s1", "s2"]}
+    arbiter.on_event(_alert("serve-queue-depth", "firing"))
+    actions = arbiter.reconcile()
+    # Victim donates its LAST slice (never the anchor s1), shrink rides
+    # the manager seam, the freed slice becomes a pool replica.
+    assert [a["action"] for a in actions] == ["preempt"]
+    assert arbiter.assignments == {"chat": ["s0", "s2"], "train": ["s1"]}
+    assert manager.lost == [("s2", 1)]
+    assert "chat-s2" in frontend.replicas
+    assert [l["slice"] for l in arbiter.loans] == ["s2"]
+    assert arbiter.counters["preemptions"] == 1
+    # Quiet rounds are free.
+    assert arbiter.reconcile() == []
+    # Resolve returns the loan: reclaim + grow, book empty again.
+    arbiter.on_event(_alert("serve-queue-depth", "resolved", value=2.0))
+    actions = arbiter.reconcile()
+    assert [a["action"] for a in actions] == ["restore"]
+    assert arbiter.assignments == {"chat": ["s0"], "train": ["s1", "s2"]}
+    assert manager.restored == [("s2", ["10.0.0.3"])]
+    assert "chat-s2" not in frontend.replicas
+    assert arbiter.loans == []
+    assert arbiter.counters["restores"] == 1
+    kinds = [e["kind"] for e in recorder.tail(50)]
+    assert kinds.count("sched_preempt") == 1
+    assert kinds.count("sched_restore") == 1
+
+
+def test_reconcile_never_preempts_below_floor_or_anchor(recorder):
+    arbiter, manager, frontend, _ = _wired_arbiter()
+    # Tighten the victim's floor to its current holding: no donor left.
+    arbiter.jobs["train"] = JobSpec(
+        name="train", kind="train", priority="prod-train",
+        min_slices=2, max_slices=2,
+    )
+    arbiter.on_event(_alert("serve-queue-depth", "firing"))
+    assert arbiter.reconcile() == []
+    assert arbiter.assignments["train"] == ["s1", "s2"]
+    assert manager.lost == []
+    # Deferral journaled once, then the page waits quietly.
+    decisions = [
+        e for e in recorder.tail(50)
+        if e["kind"] == "sched_decision" and e["action"] == "preempt-deferred"
+    ]
+    assert len(decisions) == 1
+    arbiter.reconcile()
+    decisions = [
+        e for e in recorder.tail(50)
+        if e["kind"] == "sched_decision" and e["action"] == "preempt-deferred"
+    ]
+    assert len(decisions) == 1
+    assert len(arbiter.pending_pages) == 1
+
+
+def test_reconcile_prefers_lowest_class_victim():
+    arbiter, *_ = _wired_arbiter()
+    arbiter.submit(
+        JobSpec(name="zz-batch", kind="train", priority="batch",
+                min_slices=1, max_slices=1)
+    )
+    # zz-batch holds one slice only -> not a donor (anchor rule); train
+    # (prod-train, 2 slices) is.  Give batch a second slice to make it
+    # the preferred, lower-class donor.
+    arbiter.assignments["zz-batch"] = ["x0", "x1"]
+    arbiter.inventory.update({"x0": 4, "x1": 4})
+    assert arbiter._pick_victim() == ("zz-batch", "x1")
+
+
+def test_crash_mid_preemption_resumes_without_repeating(recorder):
+    store = _Store()
+    arbiter, manager, frontend, driver = _wired_arbiter(store=store)
+    arbiter.on_event(_alert("serve-queue-depth", "firing"))
+    arbiter.reconcile()
+    assert arbiter.counters["preemptions"] == 1
+    # Crash.  A fresh arbiter resumes from the ledger; the at-least-once
+    # bus replays the same page.  The outstanding loan absorbs it.
+    resumed = FleetArbiter.resume(store, driver=driver)
+    assert [l["slice"] for l in resumed.loans] == ["s2"]
+    resumed.on_event(_alert("serve-queue-depth", "firing"))
+    assert resumed.reconcile() == []
+    assert resumed.counters["preemptions"] == 1
+    assert resumed.assignments == {"chat": ["s0", "s2"], "train": ["s1"]}
+    assert manager.lost == [("s2", 1)]  # still exactly one shrink
+    absorbed = [
+        e for e in recorder.tail(50)
+        if e["kind"] == "sched_decision" and e["action"] == "page-absorbed"
+    ]
+    assert len(absorbed) == 1
+    # The resolve still works on the resumed instance.
+    resumed.on_event(_alert("serve-queue-depth", "resolved", value=1.0))
+    assert [a["action"] for a in resumed.reconcile()] == ["restore"]
+    assert resumed.loans == []
+
+
+# --- mechanism seams ---------------------------------------------------------
+
+
+def test_rescale_grad_accum_symmetric_round_trip():
+    # Shrink direction is unchanged by the flag.
+    assert rescale_grad_accum(1, 8, 4) == 2
+    assert rescale_grad_accum(1, 8, 4, symmetric=True) == 2
+    # Default growth never reduces accum (tuning stays put)...
+    assert rescale_grad_accum(2, 4, 8) == 2
+    # ...but the scheduler's restore mode inverts the shrink exactly.
+    assert rescale_grad_accum(2, 4, 8, symmetric=True) == 1
+    shrunk = rescale_grad_accum(1, 8, 4)
+    assert rescale_grad_accum(shrunk, 4, 8, symmetric=True) == 1
+    # Non-integral inversions keep the current accum.
+    assert rescale_grad_accum(3, 4, 8, symmetric=True) == 3
+    # Equal meshes are a no-op either way.
+    assert rescale_grad_accum(4, 8, 8, symmetric=True) == 4
+
+
+def test_contract_restored_is_survivings_inverse():
+    contract = ClusterContract.build(
+        cluster_name="c",
+        coordinator_ip="10.0.0.1",
+        other_worker_ips=["10.0.0.2", "10.0.0.3", "10.0.0.4"],
+        chips_per_worker=2,
+        storage_mount="/mnt",
+        slices={"s0": ["10.0.0.1", "10.0.0.2"], "s1": ["10.0.0.3", "10.0.0.4"]},
+    )
+    shrunk = contract.surviving(["s1"])
+    assert shrunk.slice_inventory() == {"s0": 4}
+    regrown = shrunk.restored({"s1": ["10.0.0.3", "10.0.0.4"]})
+    assert regrown.slice_inventory() == contract.slice_inventory()
+    assert regrown.worker_ips == contract.worker_ips
+    assert not regrown.degraded
+    with pytest.raises(ValueError, match="already present"):
+        regrown.restored({"s1": ["10.0.0.9"]})
+    with pytest.raises(ValueError, match="no slices to restore"):
+        shrunk.restored({})
+
+
+# --- telemetry fold ----------------------------------------------------------
+
+
+def test_fold_sched_events_counts_and_last_wins():
+    from deeplearning_cfn_tpu.obs.exporter import fold_sched_events
+
+    assert fold_sched_events([]) == {}
+    assert fold_sched_events([{"kind": "step"}]) == {}
+    folded = fold_sched_events([
+        {"kind": "sched_decision", "action": "submit", "jobs": 1,
+         "free_slices": 2, "loans_outstanding": 0},
+        {"kind": "sched_decision", "action": "submit", "jobs": 2,
+         "free_slices": 0, "loans_outstanding": 0},
+        {"kind": "sched_preempt", "seq": 1, "rule": "serve-queue-depth",
+         "slice": "s2", "from_job": "train", "to_job": "chat",
+         "loans_outstanding": 1},
+        {"kind": "sched_restore", "seq": 1, "rule": "serve-queue-depth",
+         "slice": "s2", "from_job": "train", "to_job": "chat",
+         "loans_outstanding": 0},
+    ])
+    assert folded["decisions"] == 2
+    assert folded["preemptions"] == 1
+    assert folded["restores"] == 1
+    assert folded["jobs"] == 2
+    assert folded["free_slices"] == 0
+    assert folded["loans_outstanding"] == 0
+    assert folded["last"]["kind"] == "sched_restore"
+    assert folded["last"]["slice"] == "s2"
+
+
+def test_render_prometheus_sched_section():
+    from deeplearning_cfn_tpu.obs.exporter import (
+        METRIC_REGISTRY,
+        fold_sched_events,
+        render_prometheus,
+    )
+
+    sched = fold_sched_events([
+        {"kind": "sched_decision", "action": "submit", "jobs": 3,
+         "free_slices": 1, "loans_outstanding": 0},
+        {"kind": "sched_preempt", "seq": 1, "rule": "serve-queue-depth",
+         "slice": "s2", "from_job": "train", "to_job": "chat",
+         "loans_outstanding": 1},
+    ])
+    text = render_prometheus(sched=sched, cluster="c1")
+    assert 'dlcfn_sched_jobs{cluster="c1"} 3' in text
+    assert 'dlcfn_sched_slices_free{cluster="c1"} 1' in text
+    assert 'dlcfn_sched_loans_outstanding{cluster="c1"} 1' in text
+    assert 'dlcfn_sched_decisions_total{cluster="c1"} 1' in text
+    assert 'dlcfn_sched_preemptions_total{cluster="c1"} 1' in text
+    assert 'dlcfn_sched_restores_total{cluster="c1"} 0' in text
+    families = [
+        l.split()[2] for l in text.splitlines() if l.startswith("# TYPE ")
+    ]
+    assert len(families) == len(set(families))
+    for family in families:
+        assert family in METRIC_REGISTRY
+
+
+# --- CLI ---------------------------------------------------------------------
+
+
+def test_cli_sched_init_submit_resume(tmp_path, capsys):
+    from deeplearning_cfn_tpu.cli import main
+
+    ledger = tmp_path / "ledger.json"
+    assert main(["sched", "--ledger", str(ledger), "--init", "s0=4,s1=4"]) == 0
+    capsys.readouterr()
+    assert main([
+        "sched", "--ledger", str(ledger), "--submit", "chat",
+        "--kind", "serve", "--priority", "prod-serve",
+    ]) == 0
+    status = json.loads(capsys.readouterr().out)
+    assert status["assignments"]["chat"] == ["s0"]
+    # Resume-only invocation shows the persisted state.
+    assert main(["sched", "--ledger", str(ledger)]) == 0
+    status = json.loads(capsys.readouterr().out)
+    assert status["assignments"]["chat"] == ["s0"]
+    assert status["free_slices"] == ["s1"]
+    # Duplicate submit is refused with the CLI's error exit.
+    assert main([
+        "sched", "--ledger", str(ledger), "--submit", "chat",
+        "--kind", "serve",
+    ]) == 2
