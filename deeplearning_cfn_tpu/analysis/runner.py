@@ -9,6 +9,7 @@ from typing import Iterable, Iterator
 # Importing rule modules registers them in core.FILE_RULES.
 import deeplearning_cfn_tpu.analysis.collectives as collectives_rules
 import deeplearning_cfn_tpu.analysis.concurrency as concurrency_rules
+import deeplearning_cfn_tpu.analysis.determinism as determinism_rules
 import deeplearning_cfn_tpu.analysis.rules  # noqa: F401
 import deeplearning_cfn_tpu.analysis.sharding as sharding_rules
 from deeplearning_cfn_tpu.analysis import contract_check, protocol
@@ -27,11 +28,14 @@ PROTOCOL_RULE_IDS = (
 )
 
 # Rules only the dynamic sentinel stages (scripts/compile_audit.py,
-# scripts/comms_audit.py) can produce.  Their baseline entries share
-# scripts/lint_baseline.json with the static pass, so static lint must
-# never call them stale — it cannot observe their findings at all.
-DYNAMIC_AUDIT_RULE_IDS = tuple(sharding_rules.AUDIT_RULE_IDS) + tuple(
-    collectives_rules.AUDIT_RULE_IDS
+# scripts/comms_audit.py, scripts/replay_audit.py) can produce.  Their
+# baseline entries share scripts/lint_baseline.json with the static
+# pass, so static lint must never call them stale — it cannot observe
+# their findings at all.
+DYNAMIC_AUDIT_RULE_IDS = (
+    tuple(sharding_rules.AUDIT_RULE_IDS)
+    + tuple(collectives_rules.AUDIT_RULE_IDS)
+    + tuple(determinism_rules.AUDIT_RULE_IDS)
 )
 
 
@@ -59,6 +63,7 @@ def run_lint(
     protocol_pass: bool = False,
     sharding: bool = False,
     comms: bool = False,
+    determinism: bool = False,
 ) -> list[Violation]:
     """Lint the given targets (repo defaults when None).
 
@@ -70,8 +75,9 @@ def run_lint(
     ``concurrency=True`` or a ``select`` names them, never implicitly.
     Likewise the DLC3xx protocol/lifecycle checkers run when
     ``protocol_pass=True`` or selected, the DLC4xx trace-safety rules
-    when ``sharding=True`` or selected, and the DLC5xx comms/memory
-    rules when ``comms=True`` or selected.
+    when ``sharding=True`` or selected, the DLC5xx comms/memory rules
+    when ``comms=True`` or selected, and the DLC6xx determinism rules
+    when ``determinism=True`` or selected.
     """
     effective_select = select
     gated_ids: set[str] = set()
@@ -81,6 +87,8 @@ def run_lint(
         gated_ids |= set(sharding_rules.RULE_IDS)
     if comms:
         gated_ids |= set(collectives_rules.RULE_IDS)
+    if determinism:
+        gated_ids |= set(determinism_rules.RULE_IDS)
     if select is None and gated_ids:
         # Widen the per-file selection to "every ungated rule plus the
         # requested gated passes" — an explicit select is what lets gated

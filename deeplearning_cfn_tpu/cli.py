@@ -783,6 +783,19 @@ def _status_comms(args) -> dict | None:
     return dict(sorted(folded.items())) or None
 
 
+def _status_replay(args) -> dict | None:
+    """The replay-audit sentinel's latest double-run verdict (cases,
+    divergent names, clean flag) folded from journaled ``replay_audit``
+    events, or None (no journal / no audits).  Feeds the
+    ``dlcfn_replay_*`` gauges in the Prometheus rendering."""
+    if not args.journal:
+        return None
+    from deeplearning_cfn_tpu.obs.exporter import fold_replay_events
+    from deeplearning_cfn_tpu.obs.recorder import read_journal
+
+    return fold_replay_events(read_journal(args.journal, kind="replay_audit")) or None
+
+
 def _status_datastream(args) -> dict | None:
     """Data-plane counters (records/sec, shard lag, reshards, async
     checkpoint write seconds, native-loader fallbacks) folded from
@@ -933,6 +946,7 @@ def cmd_status(args) -> int:
     profile = _status_profile(args)
     serve = _status_serve(args)
     comms = _status_comms(args)
+    replay = _status_replay(args)
     datastream = _status_datastream(args)
     fleet = _status_fleet(args, liveness)
     workers = _status_metrics(args.metrics_dir) if args.metrics_dir else None
@@ -956,6 +970,7 @@ def cmd_status(args) -> int:
                 comms=comms,
                 fleet=fleet,
                 datastream=datastream,
+                replay=replay,
             ),
             end="",
         )
@@ -970,6 +985,7 @@ def cmd_status(args) -> int:
         and profile is None
         and serve is None
         and comms is None
+        and replay is None
         and datastream is None
         and fleet is None
     ):
@@ -995,6 +1011,8 @@ def cmd_status(args) -> int:
         out["serve"] = serve
     if comms is not None:
         out["comms"] = comms
+    if replay is not None:
+        out["replay"] = replay
     if datastream is not None:
         out["datastream"] = datastream
     if fleet is not None:
@@ -1271,7 +1289,8 @@ def cmd_lint(args) -> int:
     DLC1xx cross-language broker-contract checker; ``--concurrency`` adds
     the DLC2xx lockset rules, ``--protocol`` the DLC3xx message-shape
     checkers, ``--sharding`` the DLC4xx JAX/SPMD trace-safety rules,
-    ``--comms`` the DLC5xx communication/memory rules.
+    ``--comms`` the DLC5xx communication/memory rules, ``--determinism``
+    the DLC6xx nondeterminism rules.
     Exit 1 on findings not covered by ``--baseline``."""
     from deeplearning_cfn_tpu.analysis.runner import (
         DEFAULT_BASELINE,
@@ -1294,6 +1313,7 @@ def cmd_lint(args) -> int:
         protocol_pass=args.protocol,
         sharding=args.sharding,
         comms=args.comms,
+        determinism=args.determinism,
     )
 
     baseline_path = args.baseline
@@ -1638,8 +1658,8 @@ def main(argv: list[str] | None = None) -> int:
                     metavar="RULES",
                     help="comma-separated rule ids to run (e.g. "
                          "DLC001,DLC100); default: all ungated rules. "
-                         "Naming a gated id (DLC2xx/DLC3xx/DLC4xx/DLC5xx) "
-                         "enables it.")
+                         "Naming a gated id (DLC2xx/DLC3xx/DLC4xx/DLC5xx/"
+                         "DLC6xx) enables it.")
     pl.add_argument("--concurrency", action="store_true",
                     help="also run the DLC2xx lockset/thread-escape rules")
     pl.add_argument("--protocol", action="store_true",
@@ -1652,6 +1672,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="also run the DLC5xx communication/memory rules "
                          "(spec consistency/unconstrained intermediates/"
                          "host gathers/cross-mesh/shard_map reductions)")
+    pl.add_argument("--determinism", action="store_true",
+                    help="also run the DLC6xx determinism rules (unsorted "
+                         "fs enumeration/ambient entropy/set-order folds/"
+                         "hash() escapes/seed-plumbing breaks)")
     pl.add_argument("--baseline", nargs="?", metavar="PATH", default=None,
                     const=_BASELINE_DEFAULT_SENTINEL,
                     help="suppress findings recorded in this baseline file "

@@ -612,7 +612,7 @@ class FailoverBrokerConnection:
         return self._call("ping", lambda c: c.ping())
 
     def send(self, queue: str, body: bytes, rid: str | None = None) -> str:
-        rid = rid or uuid.uuid4().hex
+        rid = rid or uuid.uuid4().hex  # dlcfn: noqa[DLC601] idempotency key for a real client: must be unique across processes, so entropy is the point; sims pass explicit rids
         return self._call("send", lambda c: c.send_idempotent(queue, body, rid))
 
     def send_idempotent(self, queue: str, body: bytes, rid: str) -> str:

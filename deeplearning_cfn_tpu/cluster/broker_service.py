@@ -577,7 +577,7 @@ def ensure_broker(
             # the token never appears in /proc/<pid>/cmdline.
             import secrets
 
-            token = reuse_token or secrets.token_hex(16)
+            token = reuse_token or secrets.token_hex(16)  # dlcfn: noqa[DLC601] auth token for a real broker process: unpredictability is the requirement, not replayability
             epoch = int(reuse_epoch or 0)
             # Fresh leadership term, fresh journal: a new primary's seq
             # counter restarts at 1, so stale entries from the previous

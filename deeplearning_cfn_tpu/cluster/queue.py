@@ -24,7 +24,6 @@ from __future__ import annotations
 import itertools
 import json
 import threading
-import uuid
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -78,14 +77,19 @@ class InMemoryQueue(RendezvousQueue):
     can prove consumers dedup correctly.
     """
 
-    _seq = itertools.count()
-
     def __init__(self, name: str, clock: Clock | None = None):
         self.name = name
         self._clock = clock or MonotonicClock()
         self._lock = threading.Lock()
         self._messages: dict[str, _Stored] = {}
         self._duplicate_next_send = False
+        # Counter-derived ids, not uuid4: chaos scenarios replay this
+        # queue twice per seed and diff report bytes, so every id a
+        # fresh instance mints must be identical run over run.  In-queue
+        # uniqueness is all SQS semantics need (delete-by-receipt and
+        # visibility are per queue; consumers dedup by body content).
+        self._seq = itertools.count()
+        self._mids = itertools.count(1)
 
     @property
     def duplicate_next_send(self) -> bool:
@@ -111,7 +115,7 @@ class InMemoryQueue(RendezvousQueue):
             self._duplicate_next_send = False
             mid = ""
             for _ in range(copies):
-                mid = uuid.uuid4().hex
+                mid = f"{self.name}-m{next(self._mids):06d}"
                 self._messages[mid] = _Stored(
                     message_id=mid,
                     body=json.loads(json.dumps(body)),
@@ -134,7 +138,10 @@ class InMemoryQueue(RendezvousQueue):
             for stored in visible[:max_messages]:
                 stored.receive_count += 1
                 stored.invisible_until = now + max(visibility_timeout_s, 0.0)
-                receipt = uuid.uuid4().hex
+                # Unique per (message, receive): receive_count was just
+                # incremented under the lock, and the mid prefix keeps
+                # receipts distinct across messages.
+                receipt = f"{stored.message_id}-r{stored.receive_count}"
                 stored.receipts.add(receipt)
                 out.append(
                     Message(

@@ -58,6 +58,9 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
     "dlcfn_comms_collective_bytes": ("gauge", "Bytes moved by collectives per execution of the audited program."),
     "dlcfn_comms_peak_hbm_bytes": ("gauge", "Peak-HBM estimate (args + outputs + temps - aliased) of the audited program."),
     "dlcfn_comms_collective_count": ("gauge", "Collective ops (all-gather/all-reduce/...) in the audited program's HLO."),
+    "dlcfn_replay_cases": ("gauge", "Cases (chaos scenarios + fleet soaks) double-run by the last replay audit."),
+    "dlcfn_replay_divergent": ("gauge", "Cases whose same-seed double runs produced different report bytes."),
+    "dlcfn_replay_clean": ("gauge", "1 when the last replay audit was byte-identical everywhere, else 0."),
     # broker control plane
     "dlcfn_broker_role": ("gauge", "Broker role per node (1 = primary, 0 = standby)."),
     "dlcfn_broker_epoch": ("gauge", "Leadership term the node is fenced to."),
@@ -219,6 +222,23 @@ def fold_comms_events(events) -> dict[str, Any]:
     return out
 
 
+def fold_replay_events(events) -> dict[str, Any]:
+    """Fold flight-journal ``replay_audit`` events into the latest
+    audit's verdict (each audit journals a full summary, so last-wins
+    is the fold).  Empty dict when no replay audit ever ran."""
+    out: dict[str, Any] = {}
+    for event in events:
+        if event.get("kind") != "replay_audit":
+            continue
+        out = {
+            "clean": bool(event.get("clean")),
+            "cases": int(event.get("cases") or 0),
+            "seeds": list(event.get("seeds") or []),
+            "divergent": sorted(event.get("divergent") or []),
+        }
+    return out
+
+
 def fold_datastream_events(events) -> dict[str, Any]:
     """Fold flight-journal ``datastream`` events (data-plane progress,
     reshards, async-checkpoint writes, loader fallbacks) into the
@@ -342,6 +362,7 @@ def render_prometheus(
     fleet: Mapping[str, Any] | None = None,
     datastream: Mapping[str, Any] | None = None,
     sched: Mapping[str, Any] | None = None,
+    replay: Mapping[str, Any] | None = None,
 ) -> str:
     """Render liveness snapshot + span aggregates + input-pipeline
     counters as Prometheus text.
@@ -363,8 +384,9 @@ def render_prometheus(
     fleet merge); ``datastream`` is ``fold_datastream_events()`` (the
     sharded streaming data plane's progress/reshard/async-checkpoint
     counters); ``sched`` is ``fold_sched_events()`` (the fleet
-    arbiter's decision/preemption/loan counters).  Any may be
-    None/empty.
+    arbiter's decision/preemption/loan counters); ``replay`` is
+    ``fold_replay_events()`` (the replay-audit sentinel's double-run
+    byte-determinism verdict).  Any may be None/empty.
     """
     lines: list[str] = []
     seen: set[str] = set()
@@ -546,6 +568,22 @@ def render_prometheus(
                     f"dlcfn_comms_{key}"
                     f"{_labels(cluster=cluster, program=program)} {value}"
                 )
+    if replay:
+        head("dlcfn_replay_cases")
+        lines.append(
+            f"dlcfn_replay_cases{_labels(cluster=cluster)} "
+            f"{replay.get('cases', 0)}"
+        )
+        head("dlcfn_replay_divergent")
+        lines.append(
+            f"dlcfn_replay_divergent{_labels(cluster=cluster)} "
+            f"{len(replay.get('divergent') or [])}"
+        )
+        head("dlcfn_replay_clean")
+        lines.append(
+            f"dlcfn_replay_clean{_labels(cluster=cluster)} "
+            f"{1 if replay.get('clean') else 0}"
+        )
     if broker:
         for name in ("dlcfn_broker_role", "dlcfn_broker_epoch", "dlcfn_broker_up"):
             head(name)

@@ -121,7 +121,11 @@ class Checkpointer:
         step = self._manager.latest_step()
         if step is None:
             return None
-        state = self._manager.restore(step)
+        # A template-free StandardRestore, not a bare restore(step): a
+        # fresh CheckpointManager has no handler registered for the
+        # saved "default" item, and orbax 0.7 refuses to guess one
+        # (KeyError) — the args class is what names the handler.
+        state = self._manager.restore(step, args=ocp.args.StandardRestore())
         log.info("restored raw checkpoint step %d from %s", step, self.directory)
         return state, step
 

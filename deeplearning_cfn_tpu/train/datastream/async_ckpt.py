@@ -287,7 +287,7 @@ class AsyncShardedCheckpointer:
     # --- restore ----------------------------------------------------------
     def steps(self) -> list[int]:
         out = []
-        for p in self._dir.glob("ckpt-*.manifest.json"):
+        for p in sorted(self._dir.glob("ckpt-*.manifest.json")):
             try:
                 out.append(int(p.name.split("-")[1].split(".")[0]))
             except (IndexError, ValueError):

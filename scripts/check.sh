@@ -3,16 +3,17 @@
 # gated pass on — DLC0xx per-file rules, DLC1xx broker-contract checker,
 # DLC2xx concurrency lockset rules, DLC3xx message-shape/lifecycle
 # checkers, DLC4xx JAX/SPMD trace-safety rules, DLC5xx comms/memory
-# rules — ratcheted against the committed suppression baseline) then
-# the dynamic gates (chaos, perf-smoke, compile-audit, comms-audit) and
-# the tier-1 test suite — exactly the commands ROADMAP.md designates,
-# so CI and a developer's pre-push run cannot drift apart.
+# rules, DLC6xx determinism rules — ratcheted against the committed
+# suppression baseline) then the dynamic gates (chaos, perf-smoke,
+# compile-audit, comms-audit, replay-audit) and the tier-1 test suite —
+# exactly the commands ROADMAP.md designates, so CI and a developer's
+# pre-push run cannot drift apart.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== dlcfn lint (full: --concurrency --protocol --sharding --comms, baselined) =="
+echo "== dlcfn lint (full: --concurrency --protocol --sharding --comms --determinism, baselined) =="
 python -m deeplearning_cfn_tpu.cli lint --concurrency --protocol --sharding --comms \
-  --baseline scripts/lint_baseline.json || exit 1
+  --determinism --baseline scripts/lint_baseline.json || exit 1
 
 echo "== chaos scenarios (seeded, virtual-clock — docs/RESILIENCE.md) =="
 # --all includes slice-loss-live, which drives a real 2-slice SPMD trainer
@@ -92,6 +93,16 @@ JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python scripts/comms_audit.py --baseline scripts/lint_baseline.json \
   > /tmp/_comms_audit.json || { cat /tmp/_comms_audit.json; exit 1; }
 echo "comms-audit: collective/HBM budgets within ratchet (report: /tmp/_comms_audit.json)"
+
+echo "== replay-audit sentinel (double-run byte-determinism per seed) =="
+# Every registered chaos scenario plus soak_failover/soak_fleet runs
+# twice per seed in-process; canonical report bytes must match exactly.
+# A divergence is DLC610 with the first-divergence path and fails here
+# unless baselined (docs/STATIC_ANALYSIS.md replay runbook).
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python scripts/replay_audit.py --baseline scripts/lint_baseline.json \
+  > /tmp/_replay_audit.json || { cat /tmp/_replay_audit.json; exit 1; }
+echo "replay-audit: every scenario and soak byte-identical across double runs (report: /tmp/_replay_audit.json)"
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

@@ -733,6 +733,86 @@ def fleet_sim() -> tuple[dict, list[str]]:
     return first, failures
 
 
+DETERMINISM_SCENARIO = "silent-death"
+DETERMINISM_SOAK_AGENTS = 200
+DETERMINISM_WALL_BUDGET_S = 120.0
+
+
+def determinism() -> tuple[dict, list[str]]:
+    """Replay-determinism stage: the DLC610 sentinel's mechanics, smoke-
+    sized.  Double-runs one chaos scenario plus a scaled-down
+    ``soak_failover`` through :mod:`analysis.replay_audit` and checks
+    (1) both double-runs are byte-identical, (2) the double run never
+    touches ``time.sleep`` — scenarios and soaks wait on virtual clocks
+    only, so replaying them twice costs CPU, not wall clock — and
+    (3) wall time stays inside DETERMINISM_WALL_BUDGET_S.  The full
+    sweep over every scenario and both soaks is scripts/replay_audit.py;
+    this stage pins the sentinel's cost model."""
+    import time as _time
+
+    from deeplearning_cfn_tpu.analysis.replay_audit import (
+        ReplayCase,
+        default_cases,
+        run_replay_audit,
+    )
+    from deeplearning_cfn_tpu.analysis.schedules import soak_failover
+
+    failures: list[str] = []
+    sleep_calls = 0
+    real_sleep = _time.sleep
+
+    def counting_sleep(seconds: float) -> None:
+        nonlocal sleep_calls
+        sleep_calls += 1
+        real_sleep(seconds)
+
+    cases = default_cases(scenarios=[DETERMINISM_SCENARIO], soaks=False)
+    cases.append(
+        ReplayCase(
+            name="soak_failover_smoke",
+            kind="soak",
+            run=lambda seed: soak_failover(
+                agents=DETERMINISM_SOAK_AGENTS,
+                seed=seed,
+                kill_count=10,
+                senders=20,
+                unshipped_tail=5,
+            ),
+            audited_file="scripts/perf_smoke.py",
+        )
+    )
+    start = _time.monotonic()
+    _time.sleep = counting_sleep
+    try:
+        report = run_replay_audit(cases=cases, journal=False)
+    finally:
+        _time.sleep = real_sleep
+    wall_s = round(_time.monotonic() - start, 3)
+    for replay in report.replays:
+        if not replay.identical:
+            failures.append(
+                f"determinism stage: {replay.kind} '{replay.name}' diverged "
+                f"across a same-seed double run (first divergence at "
+                f"{replay.divergence})"
+            )
+    if sleep_calls:
+        failures.append(
+            f"determinism stage slept {sleep_calls} time(s) — the double "
+            f"run must wait on virtual clocks only"
+        )
+    if wall_s > DETERMINISM_WALL_BUDGET_S:
+        failures.append(
+            f"determinism stage took {wall_s}s, over the "
+            f"{DETERMINISM_WALL_BUDGET_S}s wall budget"
+        )
+    snapshot = {
+        "replays": [r.to_dict() for r in report.replays],
+        "sleep_calls": sleep_calls,
+        "wall_s": wall_s,
+    }
+    return snapshot, failures
+
+
 SCHED_JOBS = 6
 SCHED_SLICES = 5
 
@@ -916,6 +996,9 @@ def main() -> int:
     comms_snap, comms_failures = comms_budget()
     failures.extend(comms_failures)
 
+    det_snap, det_failures = determinism()
+    failures.extend(det_failures)
+
     if failures:
         for f in failures:
             print(f"perf-smoke: {f}", file=sys.stderr)
@@ -942,6 +1025,7 @@ def main() -> int:
                 "datastream": datastream_snap,
                 "sched": sched_snap,
                 "comms": comms_snap,
+                "determinism": det_snap,
             },
             allow_nan=False,
         )

@@ -1,0 +1,109 @@
+"""Replay-audit CI stage: per-seed byte-determinism, proven by running twice.
+
+Runs every registered chaos scenario plus both fleet soaks
+(``soak_failover``, ``soak_fleet``) twice per seed in-process under
+:mod:`analysis.replay_audit`, canonicalizes the reports to sorted-key
+compact JSON, and diffs the bytes, applying the same
+suppression-baseline ratchet as ``dlcfn lint``
+(scripts/lint_baseline.json, DLC610 namespace only):
+
+- a case whose two same-seed runs produce different bytes -> DLC610
+  (carrying the first-divergence path) -> exit 1 (unless baselined)
+- a baseline entry whose DLC610 finding no longer fires -> stale nag
+
+Exit 0 and one JSON report line on success.  docs/STATIC_ANALYSIS.md
+has the "reading a replay divergence" runbook for when this stage goes
+red.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# slice-loss-live and data-reshard-live drive a real 2-slice SPMD
+# trainer and need 8 virtual CPU devices before the JAX backend
+# initializes — same preamble as `dlcfn chaos --all` in check.sh.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--seed",
+        type=int,
+        action="append",
+        default=None,
+        metavar="N",
+        help="seed(s) to double-run at (repeatable; default: 0)",
+    )
+    parser.add_argument(
+        "--scenario",
+        action="append",
+        default=None,
+        metavar="NAME",
+        help="replay only these scenarios (repeatable; default: all "
+        "registered)",
+    )
+    parser.add_argument(
+        "--skip-soaks",
+        action="store_true",
+        help="skip soak_failover/soak_fleet (scenario-only dev loop)",
+    )
+    parser.add_argument(
+        "--baseline",
+        type=Path,
+        default=None,
+        help="suppression baseline (default scripts/lint_baseline.json)",
+    )
+    args = parser.parse_args(argv)
+
+    import logging
+
+    # The scenarios log their injected faults at WARNING; two full
+    # passes of that firehose would drown the one JSON line this stage
+    # is contracted to print.
+    logging.disable(logging.WARNING)
+
+    from deeplearning_cfn_tpu.analysis.determinism import AUDIT_RULE_IDS
+    from deeplearning_cfn_tpu.analysis.replay_audit import (
+        default_cases,
+        run_replay_audit,
+    )
+    from deeplearning_cfn_tpu.analysis.runner import apply_audit_baseline
+
+    cases = default_cases(
+        scenarios=args.scenario, soaks=not args.skip_soaks
+    )
+    seeds = tuple(args.seed) if args.seed else (0,)
+    report = run_replay_audit(cases=cases, seeds=seeds)
+
+    # This stage owns only the dynamic DLC610 namespace; lint owns the rest.
+    fresh, stale = apply_audit_baseline(
+        report.violations, args.baseline, AUDIT_RULE_IDS
+    )
+
+    for rule, rel, message in stale:
+        print(
+            f"replay-audit: stale baseline entry: {rule} {rel}: {message}",
+            file=sys.stderr,
+        )
+    for v in fresh:
+        print(f"replay-audit: {v.format()}", file=sys.stderr)
+
+    print(json.dumps(report.to_dict(), allow_nan=False))
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

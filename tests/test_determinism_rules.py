@@ -1,0 +1,296 @@
+"""DLC6xx determinism fixtures: every rule fires on its seeded
+nondeterminism and stays silent on the repo's sanctioned idiom
+(docs/STATIC_ANALYSIS.md).
+
+Like the DLC4xx/DLC5xx passes, the determinism pass is *gated*: a plain
+``lint_source`` (select=None) must never run it, so each case passes an
+explicit ``select`` — exactly how the runner enables it under
+``dlcfn lint --determinism``.  Fixture paths live under ``chaos/``
+because the pass scopes itself to the determinism-bearing tree (chaos/,
+sched/, cluster/, obs/, train/datastream/, serve/loadgen.py,
+analysis/schedules.py).
+"""
+
+import textwrap
+
+from deeplearning_cfn_tpu.analysis import lint_source
+from deeplearning_cfn_tpu.analysis.determinism import (
+    AUDIT_RULE_IDS,
+    RULE_IDS,
+)
+
+DET_PATH = "deeplearning_cfn_tpu/chaos/x.py"
+
+
+def rules_for(src: str, select: set[str], path: str = DET_PATH):
+    return [v.rule for v in lint_source(path, textwrap.dedent(src), select=select)]
+
+
+# --- the gate itself --------------------------------------------------------
+
+
+def test_gated_rules_do_not_run_without_select():
+    """Growing the DLC6xx set must never change a plain `dlcfn lint`."""
+    src = """\
+        import random
+
+        def pick(agents):
+            return random.choice(agents)
+    """
+    fired = [v.rule for v in lint_source(DET_PATH, textwrap.dedent(src))]
+    assert not set(fired) & set(RULE_IDS)
+    assert rules_for(src, select={"DLC601"}) == ["DLC601"]
+
+
+def test_rules_scope_to_the_determinism_tree():
+    """The same seeded bug under models/ is out of scope — compute-layer
+    numerics are DLC5xx's beat, not the replay contract's."""
+    src = """\
+        import random
+
+        def pick(agents):
+            return random.choice(agents)
+    """
+    assert rules_for(
+        src, {"DLC601"}, path="deeplearning_cfn_tpu/models/x.py"
+    ) == []
+    for p in (
+        "deeplearning_cfn_tpu/sched/x.py",
+        "deeplearning_cfn_tpu/cluster/x.py",
+        "deeplearning_cfn_tpu/obs/x.py",
+        "deeplearning_cfn_tpu/train/datastream/x.py",
+        "deeplearning_cfn_tpu/serve/loadgen.py",
+        "deeplearning_cfn_tpu/analysis/schedules.py",
+    ):
+        assert rules_for(src, {"DLC601"}, path=p) == ["DLC601"], p
+    # serve/ generally is out of scope; only loadgen.py is in.
+    assert rules_for(
+        src, {"DLC601"}, path="deeplearning_cfn_tpu/serve/server.py"
+    ) == []
+
+
+def test_noqa_suppresses_with_reason():
+    src = """\
+        import uuid
+
+        def request_id():
+            return uuid.uuid4().hex  # dlcfn: noqa[DLC601] idempotency key: cross-process uniqueness is the point
+    """
+    assert rules_for(src, {"DLC601"}) == []
+
+
+def test_audit_rule_id_is_reserved_not_static():
+    """DLC610 belongs to the replay sentinel (analysis/replay_audit.py):
+    no static rule may claim it, so the baseline namespaces stay
+    disjoint."""
+    assert set(AUDIT_RULE_IDS) == {"DLC610"}
+    assert not set(AUDIT_RULE_IDS) & set(RULE_IDS)
+    from deeplearning_cfn_tpu.analysis.core import FILE_RULES
+
+    assert "DLC610" not in FILE_RULES
+
+
+# --- DLC600: unsorted filesystem enumeration ---------------------------------
+
+
+def test_dlc600_fires_on_iterating_listdir():
+    src = """\
+        import os
+
+        def manifests(d):
+            out = []
+            for name in os.listdir(d):
+                out.append(name)
+            return out
+    """
+    assert rules_for(src, {"DLC600"}) == ["DLC600"]
+
+
+def test_dlc600_fires_on_returned_glob_through_list_shell():
+    """list()/tuple() shells preserve the order problem — the rule must
+    climb through them to the return."""
+    src = """\
+        def manifests(d):
+            return list(d.glob("ckpt-*.json"))
+    """
+    assert rules_for(src, {"DLC600"}) == ["DLC600"]
+
+
+def test_dlc600_tracks_assigned_name_to_its_sensitive_use():
+    src = """\
+        import os
+
+        def first_shard(d):
+            names = os.listdir(d)
+            return names[0]
+    """
+    assert rules_for(src, {"DLC600"}) == ["DLC600"]
+
+
+def test_dlc600_quiet_on_sorted_and_order_free_consumers():
+    """sorted() at the enumeration site is the fix; len()/membership/
+    truthiness never let order escape."""
+    src = """\
+        import os
+
+        def manifests(d):
+            return sorted(os.listdir(d))
+
+        def count(d):
+            return len(os.listdir(d))
+
+        def has_ckpt(d, name):
+            if os.listdir(d):
+                return name in os.listdir(d)
+            return False
+    """
+    assert rules_for(src, {"DLC600"}) == []
+
+
+# --- DLC601: ambient entropy -------------------------------------------------
+
+
+def test_dlc601_fires_on_uuid4_and_wall_clock():
+    src = """\
+        import time
+        import uuid
+
+        def deliver(msg):
+            msg["receipt"] = uuid.uuid4().hex
+            if time.time() > msg["deadline"]:
+                return None
+            return msg
+    """
+    assert rules_for(src, {"DLC601"}) == ["DLC601", "DLC601"]
+
+
+def test_dlc601_fires_on_unseeded_ctor_and_secrets():
+    src = """\
+        import random
+        import secrets
+
+        def shuffle_order():
+            rng = random.Random()
+            return secrets.token_hex(8)
+    """
+    assert rules_for(src, {"DLC601"}) == ["DLC601", "DLC601"]
+
+
+def test_dlc601_quiet_on_ts_metadata_and_clock_adapters():
+    """Recorded timestamps and the injectable default of a clock seam
+    are the sanctioned shapes — same carve-out DLC205 makes."""
+    src = """\
+        import time
+
+        def _default_clock():
+            return time.time()
+
+        def snapshot(standby):
+            return {
+                "started_ts": time.time(),
+                "resumed_ts": standby.get("started_ts", time.time()),
+            }
+
+        def seeded(seed):
+            import random
+            return random.Random(seed).random()
+    """
+    assert rules_for(src, {"DLC601"}) == []
+
+
+# --- DLC602: set-order folds -------------------------------------------------
+
+
+def test_dlc602_fires_on_iterating_set_typed_name():
+    src = """\
+        def journal(events):
+            dead = {e["agent"] for e in events}
+            lines = []
+            for agent in dead:
+                lines.append(agent)
+            return lines
+    """
+    assert rules_for(src, {"DLC602"}) == ["DLC602"]
+
+
+def test_dlc602_fires_on_comprehension_over_set_literal():
+    src = """\
+        def report():
+            return [n for n in {"b", "a", "c"}]
+    """
+    assert rules_for(src, {"DLC602"}) == ["DLC602"]
+
+
+def test_dlc602_quiet_on_sorted_fold_and_rebinding():
+    """sorted(dead) is the fix; a name rebound to sorted(...) is no
+    longer set-typed and later iteration over it is legal."""
+    src = """\
+        def journal(events):
+            dead = {e["agent"] for e in events}
+            lines = []
+            for agent in sorted(dead):
+                lines.append(agent)
+            dead = sorted(dead)
+            for agent in dead:
+                lines.append(agent)
+            return lines
+    """
+    assert rules_for(src, {"DLC602"}) == []
+
+
+# --- DLC603: hash()/id() escapes ---------------------------------------------
+
+
+def test_dlc603_fires_on_hash_and_id():
+    src = """\
+        def shard_for(key, n):
+            return hash(key) % n
+
+        def handle(obj):
+            return id(obj)
+    """
+    assert rules_for(src, {"DLC603"}) == ["DLC603", "DLC603"]
+
+
+def test_dlc603_quiet_on_dunder_hash_and_stable_digest():
+    src = """\
+        import zlib
+
+        class Key:
+            def __hash__(self):
+                return hash(self.name)
+
+        def shard_for(key, n):
+            return zlib.crc32(key.encode()) % n
+    """
+    assert rules_for(src, {"DLC603"}) == []
+
+
+# --- DLC604: seed-plumbing breaks --------------------------------------------
+
+
+def test_dlc604_fires_when_seed_param_never_reaches_the_rng():
+    src = """\
+        import random
+
+        def run_scenario(name, seed):
+            rng = random.Random()
+            return rng.random()
+    """
+    assert rules_for(src, {"DLC604"}) == ["DLC604"]
+    # ...and it is DLC604's find, not DLC601's: the ids stay disjoint
+    # so one fix clears exactly one finding.
+    assert rules_for(src, {"DLC601"}) == []
+
+
+def test_dlc604_quiet_when_seed_is_plumbed():
+    src = """\
+        import random
+        import numpy as np
+
+        def run_scenario(name, seed):
+            rng = random.Random(seed)
+            child = np.random.default_rng(seed + 1)
+            return rng.random() + child.random()
+    """
+    assert rules_for(src, {"DLC604"}) == []

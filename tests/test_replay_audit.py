@@ -1,0 +1,169 @@
+"""The replay sentinel itself: canonicalization, first-divergence
+pointers, and the double-run harness — proven against a deliberately
+order-unstable case that DLC610 must catch with the right path.
+
+The end-to-end cases run a real chaos scenario and a shrunk soak, so
+this file is also the suite's standing assertion that the per-seed
+byte-determinism contract (ROADMAP items 3/4) holds for at least one
+member of each replayed family on every test run; the full sweep lives
+in scripts/replay_audit.py behind check.sh.
+"""
+
+import pytest
+
+from deeplearning_cfn_tpu.analysis.replay_audit import (
+    CaseReplay,
+    ReplayCase,
+    canonicalize,
+    default_cases,
+    first_divergence,
+    run_replay_audit,
+)
+
+
+# --- canonicalize ------------------------------------------------------------
+
+
+def test_canonicalize_is_key_order_invariant():
+    a = {"b": 1, "a": [1, 2, {"z": 0, "y": None}]}
+    b = {"a": [1, 2, {"y": None, "z": 0}], "b": 1}
+    assert canonicalize(a) == canonicalize(b)
+    assert canonicalize(a) == b'{"a":[1,2,{"y":null,"z":0}],"b":1}'
+
+
+def test_canonicalize_never_sorts_lists():
+    """Sorting data would hide exactly the enumeration-order bugs the
+    sentinel exists to catch."""
+    assert canonicalize({"x": [2, 1]}) != canonicalize({"x": [1, 2]})
+
+
+def test_canonicalize_handles_numpy_leaves():
+    np = pytest.importorskip("numpy")
+    assert canonicalize({"n": np.int64(3), "f": np.float32(0.5)}) == (
+        b'{"f":0.5,"n":3}'
+    )
+
+
+# --- first_divergence --------------------------------------------------------
+
+
+def test_first_divergence_points_at_the_leaf():
+    assert first_divergence({"a": [1, 2]}, {"a": [1, 3]}) == "$.a[1]"
+    assert first_divergence({"a": {"b": 1}}, {"a": {}}) == "$.a.b"
+    assert first_divergence([1], [1, 2]) == "$[1]"
+    assert first_divergence({"a": 1}, {"a": 1}) is None
+    # int/float is a tolerated type pair (JSON round-trips blur it)...
+    assert first_divergence(1, 1.0) is None
+    # ...but a genuine type change is itself the divergence.
+    assert first_divergence({"a": 1}, {"a": "1"}) == "$.a"
+
+
+def test_first_divergence_walks_sorted_keys_like_canonicalize():
+    """The pointer must name the first divergence *in byte order*, so a
+    human diffing the canonical JSON lands on the same spot."""
+    a = {"z": 0, "a": 0}
+    b = {"z": 1, "a": 1}
+    assert first_divergence(a, b) == "$.a"
+
+
+# --- the double-run harness --------------------------------------------------
+
+
+def _unstable_case() -> ReplayCase:
+    """Returns a different 'rounds' order on every call — the canonical
+    shape of an unsorted enumeration leaking into a report."""
+    calls = {"n": 0}
+
+    def run(seed: int) -> dict:
+        calls["n"] += 1
+        rounds = [1, 2] if calls["n"] % 2 else [2, 1]
+        return {"seed": seed, "details": {"rounds": rounds}}
+
+    return ReplayCase(
+        name="order-unstable",
+        kind="scenario",
+        run=run,
+        audited_file="deeplearning_cfn_tpu/chaos/scenarios.py",
+    )
+
+
+def test_divergent_case_yields_dlc610_with_divergence_path():
+    report = run_replay_audit(cases=[_unstable_case()], seeds=(7,), journal=False)
+    assert len(report.replays) == 1
+    replay = report.replays[0]
+    assert not replay.identical
+    assert replay.divergence == "$.details.rounds[0]"
+    assert [v.rule for v in report.violations] == ["DLC610"]
+    msg = report.violations[0].message
+    assert "order-unstable" in msg and "seed 7" in msg
+    assert "$.details.rounds[0]" in msg
+    d = report.to_dict()
+    assert d["clean"] is False and d["divergent"] == ["order-unstable"]
+
+
+def test_stable_case_is_clean_across_seeds():
+    case = ReplayCase(
+        name="stable",
+        kind="soak",
+        run=lambda seed: {"seed": seed, "agents": [seed, seed + 1]},
+        audited_file="deeplearning_cfn_tpu/analysis/schedules.py",
+    )
+    report = run_replay_audit(cases=[case], seeds=(0, 1), journal=False)
+    assert [r.identical for r in report.replays] == [True, True]
+    assert {r.seed for r in report.replays} == {0, 1}
+    assert report.violations == []
+    assert report.to_dict()["clean"] is True
+
+
+def test_default_cases_cover_every_scenario_and_both_soaks():
+    from deeplearning_cfn_tpu.chaos.scenarios import SCENARIOS
+
+    cases = default_cases()
+    names = [c.name for c in cases]
+    assert names[: len(SCENARIOS)] == sorted(SCENARIOS)
+    assert names[-2:] == ["soak_failover", "soak_fleet"]
+    assert all(c.kind == "scenario" for c in cases[: len(SCENARIOS)])
+    assert all(c.kind == "soak" for c in cases[-2:])
+    # Each scenario case binds its OWN name (the classic late-binding
+    # closure bug would make every case replay the last scenario).
+    assert len({c.run for c in cases}) == len(cases)
+
+
+def test_one_real_scenario_and_shrunk_soak_are_byte_deterministic():
+    """The sentinel's point, asserted inside the tier-1 suite for one
+    member of each family (full sweep: scripts/replay_audit.py)."""
+    from deeplearning_cfn_tpu.analysis.schedules import soak_failover
+
+    cases = default_cases(scenarios=["silent-death"], soaks=False)
+    cases.append(
+        ReplayCase(
+            name="soak_failover_small",
+            kind="soak",
+            run=lambda seed: soak_failover(
+                agents=120, seed=seed, kill_count=8, senders=15, unshipped_tail=3
+            ),
+            audited_file="deeplearning_cfn_tpu/analysis/schedules.py",
+        )
+    )
+    report = run_replay_audit(cases=cases, seeds=(0,), journal=False)
+    assert all(r.identical for r in report.replays), [
+        (r.name, r.divergence) for r in report.replays
+    ]
+    assert report.violations == []
+
+
+def test_journal_records_replay_audit_event(tmp_path):
+    from deeplearning_cfn_tpu.obs import recorder
+
+    journal = tmp_path / "flight.jsonl"
+    recorder.configure(path=journal)
+    try:
+        run_replay_audit(cases=[_unstable_case()], seeds=(0,), journal=True)
+    finally:
+        recorder.configure()
+    events = list(recorder.read_journal(journal, kind="replay_audit"))
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["clean"] is False
+    assert ev["cases"] == 1 and ev["seeds"] == [0]
+    assert ev["divergent"] == ["order-unstable"]
