@@ -2,22 +2,41 @@
 
 ``injectors`` wraps the seams the production stack already exposes
 (GCPTransport's ``opener``, Heartbeater's ``connection_factory``, the
-RendezvousQueue interface, checkpoint I/O) with seeded fault models;
+RendezvousQueue interface, checkpoint I/O) with seeded fault models —
+disk injectors share a uniform ``wrap()`` seam so faults stack;
 ``scenarios`` composes them into named end-to-end soaks — silent-death,
 partition, flaky-rpc, slow-disk — that drive the REAL components over
-virtual time and assert recovery invariants.  ``dlcfn chaos`` is the CLI
-entry point; tests/test_chaos.py the regression harness.
+virtual time and assert recovery invariants.  ``gauntlet`` composes
+MULTIPLE faults into one incident against one end-to-end workload from
+a declarative :class:`FaultSchedule`, with a seeded sweep explorer and
+a greedy schedule shrinker.  ``dlcfn chaos`` is the CLI entry point;
+tests/test_chaos.py and tests/test_gauntlet.py the regression harness.
 """
 
+from deeplearning_cfn_tpu.chaos.gauntlet import (
+    FAULT_KINDS,
+    REGRESSION_SCHEDULES,
+    FaultEvent,
+    FaultSchedule,
+    GauntletInvariants,
+    pinned_schedule,
+    perturbed_schedule,
+    run_gauntlet,
+    run_gauntlet_sweep,
+    shrink_schedule,
+)
 from deeplearning_cfn_tpu.chaos.injectors import (
     ChaosQueue,
+    DiskInjector,
     FlakyOpener,
+    ManifestCrashDisk,
     RecordingClock,
     SlowDisk,
     StallingConnectionFactory,
     TornDisk,
 )
 from deeplearning_cfn_tpu.chaos.scenarios import (
+    SCENARIO_FAULTS,
     SCENARIOS,
     ScenarioReport,
     run_scenario,
@@ -25,12 +44,25 @@ from deeplearning_cfn_tpu.chaos.scenarios import (
 
 __all__ = [
     "ChaosQueue",
+    "DiskInjector",
+    "FAULT_KINDS",
+    "FaultEvent",
+    "FaultSchedule",
     "FlakyOpener",
+    "GauntletInvariants",
+    "ManifestCrashDisk",
+    "REGRESSION_SCHEDULES",
     "RecordingClock",
     "SCENARIOS",
+    "SCENARIO_FAULTS",
     "ScenarioReport",
     "SlowDisk",
     "StallingConnectionFactory",
     "TornDisk",
+    "perturbed_schedule",
+    "pinned_schedule",
+    "run_gauntlet",
+    "run_gauntlet_sweep",
     "run_scenario",
+    "shrink_schedule",
 ]

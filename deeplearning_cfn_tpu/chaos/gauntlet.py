@@ -1,0 +1,1027 @@
+"""The full-stack chaos gauntlet: composed multi-fault incidents.
+
+Every other chaos scenario proves one subsystem at a time.  Real
+incidents are correlated: the slice you lose mid-epoch is also the
+moment the broker shard fails over and the async checkpoint writer
+tears a manifest.  The gauntlet runs ONE real end-to-end workload —
+a 2-slice SPMD trainer on 8 virtual CPU devices, fed by the sharded
+datastream, checkpointed by :class:`AsyncShardedCheckpointer`,
+heartbeating through a 2-shard broker ring, under a live SLO engine —
+and composes faults into it from a declarative, seeded
+:class:`FaultSchedule` on one virtual clock.
+
+Fault vocabulary (:data:`FAULT_KINDS`):
+
+* ``slice-loss``          — the s1 terminate burst mid-epoch: live
+  reshard onto the survivors AND the datastream reshard in the same
+  pause (wired through the coordinator's ``on_commit`` seam).
+* ``shard-failover``      — a broker shard's primary dies and its warm
+  standby is promoted; when scheduled at the slice-loss step it
+  executes INSIDE the reshard pause (the composed case).
+* ``writer-crash``        — :class:`ManifestCrashDisk` armed so the
+  next async checkpoint dies at the manifest commit point.
+* ``telemetry-blackout``  — the SLO engine sees no fleet values for a
+  window of rounds; firing alerts must HOLD, nothing may flap.
+
+:class:`GauntletInvariants` then asserts the cross-subsystem contract
+no single-subsystem gate can see: exactly-once training records across
+the composed reshard, loss continuity against an undisturbed reference
+run (bit-exact when no reshard occurred), zero process restarts, the
+previous checkpoint fully restorable after the torn manifest, each SLO
+alert firing and resolving exactly once through the blackout, and
+byte-determinism per seed (the scenario is registered in
+``chaos.SCENARIOS`` so the DLC610 replay audit double-runs it).
+
+On top sits the seeded incident explorer: :func:`perturbed_schedule`
+draws a random-but-valid composition per seed,
+:func:`run_gauntlet_sweep` runs N of them, and :func:`shrink_schedule`
+greedily deletes events from any failing schedule until it is a
+minimal reproducer — which gets pinned in :data:`REGRESSION_SCHEDULES`
+and auto-registered as a scenario.
+"""
+
+from __future__ import annotations
+
+import random
+import shutil
+import tempfile
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Callable
+
+from deeplearning_cfn_tpu.chaos.scenarios import (
+    ScenarioReport,
+    _datastream_event_count,
+    _journal_count,
+)
+
+#: The composable fault vocabulary, in canonical order.
+FAULT_KINDS = (
+    "slice-loss",
+    "shard-failover",
+    "writer-crash",
+    "telemetry-blackout",
+)
+
+_WORK_QUEUE = "gauntlet-work"
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One scheduled fault.  ``at_step`` is the driver round (== the
+    0-based batch index; training step ``at_step`` has completed when
+    the fault executes).  ``duration`` is rounds (blackout only);
+    ``shard`` is the broker shard index (shard-failover only)."""
+
+    kind: str
+    at_step: int
+    duration: int = 0
+    shard: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"kind": self.kind, "at_step": self.at_step}
+        if self.kind == "telemetry-blackout":
+            out["duration"] = self.duration
+        if self.kind == "shard-failover":
+            out["shard"] = self.shard
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "FaultEvent":
+        return cls(
+            kind=str(d["kind"]),
+            at_step=int(d["at_step"]),
+            duration=int(d.get("duration", 0)),
+            shard=int(d.get("shard", 0)),
+        )
+
+
+@dataclass(frozen=True)
+class FaultSchedule:
+    """A seeded, declarative incident: which faults, when, composed how.
+
+    At most one event per kind — composition is ACROSS subsystems, not
+    repetition within one.  ``validate()`` returns the structural
+    errors that would make the incident un-assertable (e.g. a blackout
+    that would swallow the alert's firing window)."""
+
+    seed: int
+    events: tuple[FaultEvent, ...]
+    total_steps: int = 12
+    n_broker_shards: int = 2
+
+    def by_kind(self) -> dict[str, FaultEvent]:
+        return {e.kind: e for e in self.events}
+
+    def validate(self) -> list[str]:
+        errors: list[str] = []
+        T = self.total_steps
+        if T < 8:
+            errors.append(f"total_steps must be >= 8, got {T}")
+        kinds = [e.kind for e in self.events]
+        if len(set(kinds)) != len(kinds):
+            errors.append(f"duplicate fault kinds: {sorted(kinds)}")
+        for e in self.events:
+            if e.kind not in FAULT_KINDS:
+                errors.append(f"unknown fault kind {e.kind!r} (want {FAULT_KINDS})")
+        if any(e.kind not in FAULT_KINDS for e in self.events):
+            return errors
+        by = self.by_kind()
+        sl = by.get("slice-loss")
+        fo = by.get("shard-failover")
+        wc = by.get("writer-crash")
+        bo = by.get("telemetry-blackout")
+        if sl is not None and not (2 <= sl.at_step <= T - 6):
+            errors.append(
+                f"slice-loss at_step {sl.at_step} outside [2, {T - 6}] "
+                "(needs a loss prefix and room to fire/heal the composed alert)"
+            )
+        if fo is not None:
+            if not (1 <= fo.at_step <= T - 5):
+                errors.append(
+                    f"shard-failover at_step {fo.at_step} outside [1, {T - 5}] "
+                    "(the alert must fire and resolve inside the run)"
+                )
+            if not (0 <= fo.shard < self.n_broker_shards):
+                errors.append(
+                    f"shard-failover shard {fo.shard} outside "
+                    f"[0, {self.n_broker_shards})"
+                )
+        if wc is not None:
+            if not (1 <= wc.at_step <= T - 2):
+                errors.append(
+                    f"writer-crash at_step {wc.at_step} outside [1, {T - 2}] "
+                    "(arm needs a prior manifest and a probe round after)"
+                )
+            if sl is not None and wc.at_step <= sl.at_step:
+                errors.append(
+                    "writer-crash must land after slice-loss "
+                    f"(got {wc.at_step} <= {sl.at_step}): the incident "
+                    "narrative is a crash during/after the reshard pause, and "
+                    "the frozen checkpoint must match the surviving topology"
+                )
+        if bo is not None:
+            if not (1 <= bo.duration <= 3):
+                errors.append(f"telemetry-blackout duration {bo.duration} outside [1, 3]")
+            if bo.at_step < 1 or bo.at_step + bo.duration > T - 1:
+                errors.append(
+                    f"telemetry-blackout [{bo.at_step}, "
+                    f"{bo.at_step + bo.duration}) must sit inside [1, {T - 1}] "
+                    "(a post-blackout round must exist to heal and resolve)"
+                )
+            if fo is not None and bo.at_step < fo.at_step + 4:
+                errors.append(
+                    f"telemetry-blackout at {bo.at_step} would swallow the "
+                    f"failover alert's firing window (needs at_step >= "
+                    f"{fo.at_step + 4})"
+                )
+        return errors
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "seed": self.seed,
+            "total_steps": self.total_steps,
+            "n_broker_shards": self.n_broker_shards,
+            "events": [e.to_dict() for e in self.events],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "FaultSchedule":
+        return cls(
+            seed=int(d["seed"]),
+            events=tuple(FaultEvent.from_dict(e) for e in d["events"]),
+            total_steps=int(d.get("total_steps", 12)),
+            n_broker_shards=int(d.get("n_broker_shards", 2)),
+        )
+
+
+def pinned_schedule(seed: int) -> FaultSchedule:
+    """The pinned 3-fault incident (the check.sh gate): slice loss
+    mid-epoch, a broker shard failover COMPOSED into the same reshard
+    pause, and a writer crash at the manifest commit point two steps
+    later."""
+    die = 3 + seed % 3
+    return FaultSchedule(
+        seed=seed,
+        events=(
+            FaultEvent("slice-loss", at_step=die),
+            FaultEvent("shard-failover", at_step=die, shard=seed % 2),
+            FaultEvent("writer-crash", at_step=die + 2),
+        ),
+    )
+
+
+def perturbed_schedule(seed: int, total_steps: int = 12) -> FaultSchedule:
+    """One seeded draw from the incident space: 2-4 distinct fault
+    kinds with valid (but perturbed) timing and ordering.  Pure
+    function of ``seed`` — the sweep explorer's generator."""
+    rng = random.Random(0x6AA7 ^ (seed * 2654435761 % (1 << 32)))
+    T = total_steps
+    n_kinds = rng.randint(2, 4)
+    kinds = sorted(rng.sample(FAULT_KINDS, n_kinds), key=FAULT_KINDS.index)
+    events: list[FaultEvent] = []
+    sl_at: int | None = None
+    fo_at: int | None = None
+    for kind in kinds:
+        if kind == "slice-loss":
+            sl_at = rng.randint(2, T - 6)
+            events.append(FaultEvent(kind, at_step=sl_at))
+        elif kind == "shard-failover":
+            if sl_at is not None and rng.random() < 0.5:
+                fo_at = sl_at  # composed: failover inside the reshard pause
+            else:
+                fo_at = rng.randint(1, T - 5)
+            events.append(FaultEvent(kind, at_step=fo_at, shard=rng.randrange(2)))
+        elif kind == "writer-crash":
+            lo = 1 if sl_at is None else sl_at + 1
+            events.append(FaultEvent(kind, at_step=rng.randint(lo, T - 2)))
+        elif kind == "telemetry-blackout":
+            lo = 1 if fo_at is None else fo_at + 4
+            if lo > T - 2:
+                continue  # no room for a post-blackout resolve round
+            at = rng.randint(lo, T - 2)
+            dur = rng.randint(1, min(3, T - 1 - at))
+            events.append(FaultEvent(kind, at_step=at, duration=dur))
+    return FaultSchedule(seed=seed, events=tuple(events), total_steps=T)
+
+
+class GauntletInvariants:
+    """The cross-subsystem invariant catalog, conditioned on which
+    faults the schedule composed.  ``verify(report, obs)`` runs every
+    applicable check against the facts the engine observed."""
+
+    def __init__(self, schedule: FaultSchedule):
+        self.schedule = schedule
+        self.by = schedule.by_kind()
+        self.kinds = set(self.by)
+
+    def verify(self, report: ScenarioReport, obs: dict[str, Any]) -> None:
+        import numpy as np
+
+        T = self.schedule.total_steps
+        sl = self.by.get("slice-loss")
+        fo = self.by.get("shard-failover")
+        wc = self.by.get("writer-crash")
+        bo = self.by.get("telemetry-blackout")
+
+        # --- training plane ---------------------------------------------
+        report.check(
+            len(obs["losses"]) == T and obs["final_step"] == T,
+            "zero process restarts: one fit() call trained every step "
+            "through the composed incident (monotone step count)",
+        )
+        if sl is not None:
+            report.check(
+                obs["live_total"] == 1 and obs["fallback_total"] == 0,
+                "the terminate burst coalesced into exactly one live "
+                "reshard and zero fallbacks",
+            )
+            report.check(
+                obs["journal"]["slice_loss_coalesced"] == 1
+                and obs["journal"]["reshard"] == 1,
+                "journal shows one coalesced slice loss and one reshard",
+            )
+            report.check(
+                obs["post_mesh"] == {"devices": 4, "axes": {"fsdp": 4}}
+                and obs["grad_accum"] == 2,
+                "trainer rebound to the surviving 4-device fsdp mesh with "
+                "grad accumulation rescaled 1 -> 2 (global batch preserved)",
+            )
+            report.check(
+                bool(
+                    np.allclose(
+                        obs["losses"][: sl.at_step],
+                        obs["straight"][: sl.at_step],
+                        rtol=1e-5,
+                        atol=1e-6,
+                    )
+                ),
+                "pre-incident losses identical to the undisturbed run",
+            )
+            report.check(
+                bool(
+                    np.allclose(obs["losses"], obs["straight"], rtol=5e-3, atol=1e-4)
+                ),
+                "loss continuity across the composed incident: full curve "
+                "matches the undisturbed run within tolerance",
+            )
+        else:
+            report.check(
+                obs["live_total"] == 0 and obs["journal"]["reshard"] == 0,
+                "no slice loss scheduled: zero reshards executed",
+            )
+            report.check(
+                obs["losses"] == obs["straight"],
+                "without a reshard the incident is arithmetic-invisible: "
+                "loss curve bit-identical to the undisturbed run",
+            )
+
+        # --- data plane (exactly-once records) --------------------------
+        report.check(
+            obs["plane_seen"] == list(range(obs["plane_total"])),
+            "every datastream record consumed exactly once across the "
+            "incident (zero dropped, zero duplicated)",
+        )
+        report.check(
+            obs["journal"]["datastream_reshard"] == (1 if sl is not None else 0),
+            "datastream resharded exactly once per slice loss (inside the "
+            "same pause as the mesh reshard), never otherwise",
+        )
+
+        # --- checkpoint plane -------------------------------------------
+        if wc is not None:
+            report.check(
+                obs["latest_at_arm"] == wc.at_step,
+                "the writer had committed the arm-step manifest before the "
+                "crash was armed (deterministic crash point)",
+            )
+            report.check(
+                obs["write_failures"] == 1 and obs["disk_crashes"] == 1
+                and obs["journal"]["checkpoint_write_failed"] == 1,
+                "the armed crash fired exactly once at the manifest commit "
+                "point and was journaled (writer thread survived)",
+            )
+            report.check(
+                not obs["crashed_manifest_exists"] and obs["crashed_shard_exists"],
+                "the crashed step left shard litter but NO manifest: the "
+                "commit point never passed",
+            )
+            report.check(
+                obs["restore_step"] == wc.at_step
+                and obs["restore_stream_records"] == wc.at_step * 32,
+                "the previous checkpoint (state + stream cursor) is fully "
+                "restorable after the torn manifest — no training step or "
+                "record position lost",
+            )
+        report.check(
+            obs["final_latest"] == T,
+            "the async writer recovered past the incident: the final step's "
+            "manifest committed",
+        )
+
+        # --- broker plane ------------------------------------------------
+        report.check(
+            obs["work_depth"] == T and obs["resends"] == (1 if fo is not None else 0),
+            "idempotent work submission is exactly-once through the "
+            "incident: the post-failover re-send storm deduplicated, depth "
+            "== one entry per round",
+        )
+        if fo is not None:
+            report.check(
+                obs["failed_shard_epoch"] == 1 and obs["reprovisions"] == 1,
+                "the failed shard promoted its standby (epoch fenced 0 -> 1) "
+                "and auto-re-provisioned a fresh one, exactly once",
+            )
+            report.check(
+                obs["healed_pairs"] == self.schedule.n_broker_shards,
+                "every broker shard pair is whole and caught up at the end "
+                "(zero replication lag after the failover)",
+            )
+            report.check(
+                obs["healthy_shard_failovers"] == 0,
+                "zero spurious client failovers on the unaffected shard",
+            )
+        else:
+            report.check(
+                obs["healed_pairs"] == self.schedule.n_broker_shards
+                and obs["total_failovers"] == 0
+                and obs["reprovisions"] == 0,
+                "no failover scheduled: the ring stayed whole, zero client "
+                "failovers, zero re-provisions",
+            )
+
+        # --- SLO plane ----------------------------------------------------
+        expect_fired = 1 if fo is not None else 0
+        report.check(
+            obs["slo"]["fired_count"] == expect_fired
+            and obs["slo"]["resolved_count"] == expect_fired
+            and not obs["slo"]["firing"],
+            "each SLO alert fired and resolved exactly once for the "
+            "incident (zero flaps, nothing left firing)",
+        )
+        if bo is not None:
+            blackout = range(bo.at_step, bo.at_step + bo.duration)
+            report.check(
+                all(t["round"] not in blackout for t in obs["transitions"]),
+                "zero alert transitions during the telemetry blackout "
+                "(absence of evidence neither fires nor resolves)",
+            )
+            if fo is not None:
+                report.check(
+                    all(obs["firing_by_round"][r] for r in blackout),
+                    "the firing alert HELD through the telemetry blackout "
+                    "(no flap on missing data)",
+                )
+
+
+# Memoised reference loss curves keyed by (seed, total_steps); see the
+# "undisturbed reference run" block in run_gauntlet.
+_STRAIGHT_CACHE: dict[tuple[int, int], tuple[float, ...]] = {}
+
+
+def run_gauntlet(schedule: FaultSchedule) -> ScenarioReport:
+    """Run one composed incident end-to-end and return its report.
+
+    The workload is real: an FSDP trainer on a 2-slice hybrid mesh (8
+    virtual CPU devices) pulling record batches from a single-host
+    shard stream, an id-carrying 4-host :class:`DataStreamPlane`
+    exercising the datastream reshard, an async sharded checkpointer
+    capturing the stream cursor every step, a 2-shard replicated broker
+    ring carrying heartbeats + idempotent work, and an SLO engine
+    watching broker pair health — all on ONE virtual clock, with the
+    schedule's faults injected at their rounds.  Deterministic per
+    seed: ``report.to_dict()`` is byte-identical across runs.
+    """
+    errors = schedule.validate()
+    if errors:
+        raise ValueError("invalid fault schedule: " + "; ".join(errors))
+
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    import jax
+    import numpy as np
+    import flax.linen as nn
+
+    from deeplearning_cfn_tpu.analysis.schedules import (
+        ShardedSimBroker,
+        ShardedSimConnection,
+        VirtualClock,
+        interleavings,
+    )
+    from deeplearning_cfn_tpu.chaos.injectors import ManifestCrashDisk
+    from deeplearning_cfn_tpu.cluster.contract import ClusterContract
+    from deeplearning_cfn_tpu.cluster.elasticity import (
+        ElasticityController,
+        GroupPolicy,
+    )
+    from deeplearning_cfn_tpu.cluster.recovery import LiveReshardManager
+    from deeplearning_cfn_tpu.obs.recorder import get_recorder
+    from deeplearning_cfn_tpu.obs.slo import SloEngine, SloRule
+    from deeplearning_cfn_tpu.parallel.mesh import (
+        MeshSpec,
+        hybrid_mesh_for_slices,
+        virtual_cpu_devices,
+    )
+    from deeplearning_cfn_tpu.provision.events import (
+        EventBus,
+        EventKind,
+        LifecycleEvent,
+    )
+    from deeplearning_cfn_tpu.train.data import SyntheticDataset
+    from deeplearning_cfn_tpu.train.datastream import (
+        AsyncShardedCheckpointer,
+        DataStreamPlane,
+        HostShardStream,
+    )
+    from deeplearning_cfn_tpu.train.records import (
+        Field,
+        RecordSpec,
+        write_dataset,
+        write_records,
+    )
+    from deeplearning_cfn_tpu.train.reshard import (
+        LiveReshardCoordinator,
+        mesh_topology,
+        rescale_grad_accum,
+    )
+    from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
+
+    seed = schedule.seed
+    T = schedule.total_steps
+    by = schedule.by_kind()
+    sl_ev = by.get("slice-loss")
+    fo_ev = by.get("shard-failover")
+    wc_ev = by.get("writer-crash")
+    bo_ev = by.get("telemetry-blackout")
+    composed_failover = (
+        fo_ev is not None and sl_ev is not None and fo_ev.at_step == sl_ev.at_step
+    )
+    blackout_rounds = (
+        range(bo_ev.at_step, bo_ev.at_step + bo_ev.duration) if bo_ev else range(0)
+    )
+
+    report = ScenarioReport("gauntlet", seed)
+    report.faults = [e.to_dict() for e in schedule.events]
+    report.details["schedule"] = schedule.to_dict()
+
+    devices = virtual_cpu_devices(8)
+
+    class _Net(nn.Module):
+        # fc2's 256x256 kernel clears the FSDP heuristic's
+        # min_shard_elems, so the reshard moves genuinely sharded arrays.
+        @nn.compact
+        def __call__(self, x):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(256, name="fc1")(x))
+            x = nn.relu(nn.Dense(256, name="fc2")(x))
+            return nn.Dense(10, name="head")(x)
+
+    def make_contract() -> ClusterContract:
+        return ClusterContract.build(
+            cluster_name="chaos-gauntlet",
+            coordinator_ip="10.0.0.1",
+            other_worker_ips=["10.0.0.2", "10.0.0.3", "10.0.0.4"],
+            chips_per_worker=2,
+            storage_mount="/mnt/none",
+            slices={
+                "s0": ["10.0.0.1", "10.0.0.2"],
+                "s1": ["10.0.0.3", "10.0.0.4"],
+            },
+        )
+
+    def mesh_for(contract: ClusterContract):
+        n = contract.slices_count
+        per_slice = contract.total_chips // max(n, 1)
+        return hybrid_mesh_for_slices(
+            n,
+            ici_spec=MeshSpec.fsdp_parallel(per_slice),
+            dcn_axis="dp",
+            devices=devices[: contract.total_chips],
+        )
+
+    def make_config() -> TrainerConfig:
+        return TrainerConfig(
+            optimizer="adamw",
+            learning_rate=1e-3,
+            strategy="fsdp",
+            matmul_precision="float32",
+            log_every=1,
+            grad_accum_steps=1,
+        )
+
+    root = Path(tempfile.mkdtemp(prefix="dlcfn-gauntlet-"))
+    obs: dict[str, Any] = {}
+    try:
+        # --- training records: 2 shards x 128 = 256 = 8 batches of 32,
+        # single-host so the record order (and thus the loss curve) is
+        # topology-independent; loop=True covers all 12 steps.
+        spec2 = RecordSpec.classification((8, 8, 1), "float32")
+        tpaths: list[Path] = []
+        for i in range(2):
+            ds = SyntheticDataset(
+                shape=(8, 8, 1), num_classes=10, batch_size=32, seed=seed * 7 + i
+            )
+            p = root / f"train-{i}.dlc"
+            write_dataset(p, spec2, ds.batches(4), 4)
+            tpaths.append(p)
+
+        def train_stream(state=None) -> HostShardStream:
+            return HostShardStream(
+                tpaths,
+                spec2,
+                32,
+                host="10.0.0.1",
+                hosts=("10.0.0.1",),
+                seed=seed,
+                loop=True,
+                state=state,
+            )
+
+        sample = next(train_stream().batches(1)).x
+
+        # --- id-carrying datastream plane: 6 uneven shards over 4 hosts,
+        # gid baked into y so exactly-once is literal.
+        idspec = RecordSpec((Field("x", "uint8", (2,)), Field("y", "int32", ())))
+        sizes = [17 + (3 * sid + seed) % 7 for sid in range(6)]
+        ipaths: list[Path] = []
+        gid = 0
+        for sid, n in enumerate(sizes):
+            recs = []
+            for _ in range(n):
+                recs.append(
+                    idspec.encode(
+                        x=np.array([gid % 251, gid % 7], dtype=np.uint8),
+                        y=np.int32(gid),
+                    )
+                )
+                gid += 1
+            p = root / f"ids-{sid:02d}.dlc"
+            write_records(p, idspec, recs)
+            ipaths.append(p)
+        plane_total = gid
+
+        # --- the undisturbed reference run ------------------------------
+        # The reference curve is a pure function of (seed, T): the training
+        # records, init key, and step count fully determine it, and it runs
+        # before the journal delta is captured, so memoising it across the
+        # many same-seed runs a test process makes changes nothing observable.
+        if (seed, T) not in _STRAIGHT_CACHE:
+            trainer_s = Trainer(_Net(), mesh_for(make_contract()), make_config())
+            state_s = trainer_s.init(jax.random.PRNGKey(seed), sample)
+            _, fresh = trainer_s.fit(
+                state_s, train_stream().batches(), steps=T, prefetch=0
+            )
+            _STRAIGHT_CACHE[(seed, T)] = tuple(float(v) for v in fresh)
+        straight = list(_STRAIGHT_CACHE[(seed, T)])
+
+        # --- the world on one virtual clock -----------------------------
+        vclock = VirtualClock()
+
+        class _Backend:
+            def __init__(self):
+                self.events = EventBus()
+
+        backend = _Backend()
+        controller = ElasticityController(
+            backend=backend,
+            coordinator_queue_name="coord",
+            slice_loss_window_s=10.0,
+            clock=vclock,
+        )
+        controller.register(GroupPolicy("s0", 1, "sig-s0", coordinator=True))
+        controller.register(GroupPolicy("s1", 1, "sig-s1"))
+        controller.attach()
+        manager = LiveReshardManager(make_contract())
+        manager.attach(controller)
+
+        plane = DataStreamPlane(
+            make_contract(), ipaths, idspec, batch_size=5, seed=seed, loop=False
+        )
+        plane_iters = {h: plane.stream(h).batches() for h in plane.hosts}
+        plane_ids: dict[str, list[int]] = {h: [] for h in plane.hosts}
+
+        broker = ShardedSimBroker(vclock, n_shards=schedule.n_broker_shards)
+        host_conns = {
+            h: ShardedSimConnection(broker) for h in make_contract().datastream_hosts()
+        }
+        work_conn = ShardedSimConnection(broker)
+
+        rule = SloRule(
+            name="gauntlet-broker-degraded",
+            metric="dlcfn_gauntlet_broker_degraded_pairs",
+            agg="value",
+            op=">",
+            threshold=0.0,
+            for_s=2.0,
+            severity="page",
+            description="gauntlet: a broker shard pair is degraded "
+            "(failover in progress, replication lag, or a dead primary)",
+        )
+        slo = SloEngine(rules=(rule,), clock=vclock, bus=backend.events)
+        transitions: list[dict[str, Any]] = []
+        firing_by_round: list[bool] = []
+
+        disk = ManifestCrashDisk(once=True)
+        ck = AsyncShardedCheckpointer(
+            root / "ckpt", every_steps=1, n_shards=2, io=disk
+        )
+        frozen = root / "frozen"
+
+        state = {
+            "failover_done": False,
+            "healed": False,
+            "resend_due": False,
+            "resends": 0,
+        }
+        # A blackout scheduled after the failover (validation guarantees
+        # the alert fires first) defers healing until telemetry is back:
+        # automation cannot confirm pair health while the fleet is dark,
+        # which is exactly the window the hold-don't-flap invariant needs.
+        heal_from = 0
+        if fo_ev is not None and bo_ev is not None:
+            heal_from = bo_ev.at_step + bo_ev.duration
+
+        def do_failover() -> None:
+            shard = broker.shards[fo_ev.shard]
+            shard.kill_primary()
+            shard.promote_standby()
+            state["failover_done"] = True
+            state["resend_due"] = True
+
+        def on_commit(contract) -> None:
+            # The composed pause: the datastream reshards at the SAME
+            # step boundary as the mesh, and — when scheduled — the
+            # broker shard fails over inside that pause.
+            plane.reshard(contract)
+            if composed_failover:
+                do_failover()
+
+        coordinator = LiveReshardCoordinator(
+            manager=manager,
+            mesh_for=mesh_for,
+            flush=controller.flush_slice_losses,
+            clock=vclock,
+            on_commit=on_commit,
+        )
+
+        burst = ["10.0.0.3", "10.0.0.4", "10.0.0.3"]  # dup on purpose
+        order = list(interleavings(burst, count=1, seed=seed)[0])
+
+        def driver(src):
+            """The world loop, advanced once per produced batch: faults,
+            heartbeats, idempotent work, replication, healing, SLO
+            evaluation, and one id-plane round — all deterministic."""
+            for i, b in enumerate(src):
+                # 1. scheduled faults for this round
+                if sl_ev is not None and i == sl_ev.at_step:
+                    for ip in order:
+                        backend.events.publish(
+                            LifecycleEvent(
+                                kind=EventKind.INSTANCE_TERMINATE,
+                                group="s1",
+                                instance_id=ip,
+                                detail={"reason": "preempted"},
+                            )
+                        )
+                        vclock.advance(0.5)
+                    vclock.advance(11.0)
+                if fo_ev is not None and not composed_failover and i == fo_ev.at_step:
+                    do_failover()
+                if wc_ev is not None and i == wc_ev.at_step:
+                    ck.wait()
+                    obs["latest_at_arm"] = ck.latest_step()
+                    disk.arm()
+                if wc_ev is not None and i == wc_ev.at_step + 1:
+                    # Probe: the crashed step's save has been attempted
+                    # (and failed) by now; freeze the directory as the
+                    # post-crash disk image for the restorability check.
+                    ck.wait()
+                    obs["write_failures"] = ck.write_failures
+                    obs["disk_crashes"] = disk.crashes
+                    crashed = wc_ev.at_step + 1
+                    obs["crashed_manifest_exists"] = (
+                        root / "ckpt" / f"ckpt-{crashed:08d}.manifest.json"
+                    ).exists()
+                    obs["crashed_shard_exists"] = (
+                        root / "ckpt" / f"ckpt-{crashed:08d}.shard-00-of-02.json"
+                    ).exists()
+                    shutil.copytree(root / "ckpt", frozen)
+                # 2. the at-least-once re-send storm after a failover
+                if state["resend_due"] and i >= 1:
+                    rid = f"w-{i - 1:03d}"
+                    work_conn.send_idempotent(_WORK_QUEUE, rid.encode(), rid)
+                    state["resends"] += 1
+                    state["resend_due"] = False
+                # 3. heartbeats from every live host
+                for h in list(plane.hosts):
+                    host_conns[h].heartbeat(h)
+                # 4. this round's idempotent work submission
+                rid = f"w-{i:03d}"
+                work_conn.send_idempotent(_WORK_QUEUE, rid.encode(), rid)
+                # 5. replication pass (healthy shards stay caught up)
+                broker.stream_all()
+                # 6. auto-heal: once the alert fired (and telemetry is
+                # back), the acting primary re-provisions a fresh standby
+                if (
+                    state["failover_done"]
+                    and not state["healed"]
+                    and i >= heal_from
+                    and i not in blackout_rounds
+                    and slo.snapshot()[rule.name]["firing"]
+                ):
+                    broker.shards[fo_ev.shard].reprovision_standby()
+                    state["healed"] = True
+                # 7. SLO evaluation (a blackout round observes nothing)
+                if i in blackout_rounds:
+                    values: dict[str, dict[str, float]] = {}
+                else:
+                    values = {
+                        rule.metric: {
+                            "value": float(
+                                broker.n_shards - broker.healed_pairs()
+                            )
+                        }
+                    }
+                for t in slo.evaluate(values):
+                    transitions.append({"round": i, "rule": t["rule"], "state": t["state"]})
+                firing_by_round.append(slo.snapshot()[rule.name]["firing"])
+                vclock.advance(1.0)
+                # 8. one id-plane round across the live hosts
+                for h in list(plane.hosts):
+                    nb = next(plane_iters[h], None)
+                    if nb is not None:
+                        plane_ids[h].extend(int(v) for v in nb.y)
+                yield b
+
+        journal_before = {
+            "slice_loss_coalesced": _journal_count("slice_loss_coalesced"),
+            "reshard": _journal_count("reshard"),
+            "checkpoint_write_failed": _datastream_event_count(
+                "checkpoint_write_failed"
+            ),
+            "datastream_reshard": _datastream_event_count("reshard"),
+        }
+
+        trainer = Trainer(_Net(), mesh_for(manager.contract), make_config())
+        tstate = trainer.init(jax.random.PRNGKey(seed), sample)
+        stream = train_stream()
+        tstate, losses = trainer.fit(
+            tstate,
+            driver(stream.batches()),
+            steps=T,
+            prefetch=0,
+            checkpointer=ck,
+            datastream=stream,
+            reshard=coordinator,
+        )
+        ck.wait()
+
+        # --- gather the facts -------------------------------------------
+        obs["losses"] = losses
+        obs["straight"] = straight
+        obs["final_step"] = int(jax.device_get(tstate.step))
+        obs["live_total"] = coordinator.live_total
+        obs["fallback_total"] = coordinator.fallback_total
+        obs["post_mesh"] = mesh_topology(trainer.mesh)
+        obs["grad_accum"] = int(trainer.config.grad_accum_steps)
+        obs["journal"] = {
+            "slice_loss_coalesced": _journal_count("slice_loss_coalesced")
+            - journal_before["slice_loss_coalesced"],
+            "reshard": _journal_count("reshard") - journal_before["reshard"],
+            "checkpoint_write_failed": _datastream_event_count(
+                "checkpoint_write_failed"
+            )
+            - journal_before["checkpoint_write_failed"],
+            "datastream_reshard": _datastream_event_count("reshard")
+            - journal_before["datastream_reshard"],
+        }
+
+        for h in tuple(plane.hosts):  # survivors drain the epoch
+            for nb in plane_iters[h]:
+                plane_ids[h].extend(int(v) for v in nb.y)
+        obs["plane_seen"] = sorted(v for ids in plane_ids.values() for v in ids)
+        obs["plane_total"] = plane_total
+
+        obs["final_latest"] = ck.latest_step()
+        if wc_ev is not None:
+            ckf = AsyncShardedCheckpointer(frozen, every_steps=1, n_shards=2)
+            try:
+                cfg_r = make_config()
+                if manager.contract.degraded:
+                    cfg_r.grad_accum_steps = rescale_grad_accum(
+                        1, 8, mesh_for(manager.contract).size
+                    )
+                trainer_r = Trainer(_Net(), mesh_for(manager.contract), cfg_r)
+                template = trainer_r.init(jax.random.PRNGKey(seed), sample)
+                restored = ckf.restore_latest(template=template)
+                obs["restore_step"] = None if restored is None else restored[1]
+                obs["restore_stream_records"] = (
+                    (ckf.last_stream_state or {}).get("records_total")
+                )
+            finally:
+                ckf.close()
+        ck.close()
+
+        broker.stream_all()
+        obs["healed_pairs"] = broker.healed_pairs()
+        obs["reprovisions"] = sum(s.reprovisions for s in broker.shards)
+        obs["resends"] = state["resends"]
+        work_node = broker.route(_WORK_QUEUE).active()
+        obs["work_depth"] = 0 if work_node is None else work_node.depth(_WORK_QUEUE)
+        obs["total_failovers"] = work_conn.failovers + sum(
+            c.failovers for c in host_conns.values()
+        )
+        if fo_ev is not None:
+            failed = broker.shards[fo_ev.shard]
+            acting = failed.active()
+            obs["failed_shard_epoch"] = -1 if acting is None else acting.epoch
+            healthy = [
+                k for k in range(broker.n_shards) if k != fo_ev.shard
+            ]
+            obs["healthy_shard_failovers"] = sum(
+                conn._conns[k].failovers
+                for conn in [work_conn, *host_conns.values()]
+                for k in healthy
+            )
+        obs["slo"] = slo.snapshot()[rule.name]
+        obs["transitions"] = transitions
+        obs["firing_by_round"] = firing_by_round
+
+        GauntletInvariants(schedule).verify(report, obs)
+
+        report.details.update(
+            straight_losses=[round(v, 6) for v in straight],
+            gauntlet_losses=[round(v, 6) for v in losses],
+            plane_records=plane_total,
+            plane_per_host={h: len(ids) for h, ids in sorted(plane_ids.items())},
+            work_depth=obs["work_depth"],
+            resends=obs["resends"],
+            healed_pairs=obs["healed_pairs"],
+            alert_timeline=transitions,
+            journal_deltas=obs["journal"],
+            restore_step=obs.get("restore_step"),
+        )
+        get_recorder().record(
+            "gauntlet",
+            event="run",
+            seed=seed,
+            passed=bool(report.passed),
+            faults=len(schedule.events),
+            violations=len(report.violations),
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return report
+
+
+def shrink_schedule(
+    schedule: FaultSchedule,
+    still_fails: Callable[[FaultSchedule], bool],
+) -> FaultSchedule:
+    """Greedy delta-debugging: repeatedly drop the first event whose
+    removal keeps the schedule both valid and failing, until no single
+    removal does.  Deterministic (fixed scan order), never returns an
+    empty schedule — the minimal reproducer to pin as a regression."""
+    current = schedule
+    shrunk = True
+    while shrunk and len(current.events) > 1:
+        shrunk = False
+        for i in range(len(current.events)):
+            events = current.events[:i] + current.events[i + 1 :]
+            candidate = FaultSchedule(
+                seed=current.seed,
+                events=events,
+                total_steps=current.total_steps,
+                n_broker_shards=current.n_broker_shards,
+            )
+            if candidate.validate():
+                continue
+            if still_fails(candidate):
+                current = candidate
+                shrunk = True
+                break
+    return current
+
+
+#: Pinned minimal reproducers from past sweep failures, auto-registered
+#: as scenarios (name -> schedule).  Every entry here is a bug that WAS
+#: shrunk, fixed at source, and kept as a permanent regression gate.
+REGRESSION_SCHEDULES: dict[str, FaultSchedule] = {}
+
+
+def _register_regressions() -> None:
+    """Each pinned reproducer becomes a scenario of its own, joining
+    the chaos gate and the DLC610 replay audit automatically.  The
+    schedule is fixed; the seed argument is ignored by design — a
+    reproducer replays ONE incident exactly."""
+    from deeplearning_cfn_tpu.chaos import scenarios as _scenarios
+
+    def make(schedule: FaultSchedule):
+        def run(seed: int) -> ScenarioReport:
+            return run_gauntlet(schedule)
+
+        run.__doc__ = "Pinned gauntlet regression reproducer (fixed schedule)."
+        return run
+
+    for name, schedule in sorted(REGRESSION_SCHEDULES.items()):
+        _scenarios.SCENARIOS[f"gauntlet-{name}"] = make(schedule)
+        _scenarios.SCENARIO_FAULTS[f"gauntlet-{name}"] = tuple(
+            e.kind for e in schedule.events
+        )
+
+
+_register_regressions()
+
+
+def run_gauntlet_sweep(
+    n_seeds: int = 20,
+    base_seed: int = 0,
+    runner: Callable[[FaultSchedule], ScenarioReport] = run_gauntlet,
+    shrink: bool = True,
+) -> dict[str, Any]:
+    """The seeded incident explorer: run ``n_seeds`` perturbed fault
+    schedules; for every failing one, greedily shrink it to a minimal
+    reproducer.  Returns a deterministic summary (and journals a
+    ``gauntlet``/``sweep`` event for the exporter)."""
+    from deeplearning_cfn_tpu.obs.recorder import get_recorder
+
+    failures: list[dict[str, Any]] = []
+    fault_counts: dict[str, int] = {k: 0 for k in FAULT_KINDS}
+    for s in range(n_seeds):
+        schedule = perturbed_schedule(base_seed + s)
+        for e in schedule.events:
+            fault_counts[e.kind] += 1
+        rep = runner(schedule)
+        if not rep.passed:
+            entry: dict[str, Any] = {
+                "seed": schedule.seed,
+                "schedule": schedule.to_dict(),
+                "violations": list(rep.violations),
+            }
+            if shrink:
+                minimal = shrink_schedule(
+                    schedule, lambda sc: not runner(sc).passed
+                )
+                entry["shrunk"] = minimal.to_dict()
+            failures.append(entry)
+    summary = {
+        "seeds": n_seeds,
+        "base_seed": base_seed,
+        "passed": n_seeds - len(failures),
+        "failures": failures,
+        "fault_counts": fault_counts,
+    }
+    get_recorder().record(
+        "gauntlet",
+        event="sweep",
+        seeds=n_seeds,
+        base_seed=base_seed,
+        failures=len(failures),
+    )
+    return summary

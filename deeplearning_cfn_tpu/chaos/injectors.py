@@ -18,7 +18,10 @@ Seams covered:
 * :class:`TornDisk` / :class:`SlowDisk` / :class:`ManifestCrashDisk` —
   checkpoint ``CheckpointIO``: torn writes (a prefix lands, then
   OSError), high-latency disks on virtual time, and a writer crash at
-  the async sharded checkpointer's manifest commit point.
+  the async sharded checkpointer's manifest commit point.  All disk
+  injectors share the :class:`DiskInjector` ``wrap()`` seam, so two
+  faults stack deterministically (outermost injector first):
+  ``SlowDisk(clock).wrap(TornDisk(seed))``.
 """
 
 from __future__ import annotations
@@ -248,23 +251,12 @@ class ChaosQueue(RendezvousQueue):
 # --- disk layer --------------------------------------------------------------
 
 
-class TornDisk:
-    """CheckpointIO-compatible torn-write disk: seeded writes persist only
-    a prefix of the bytes, then raise OSError — the fault the atomic
-    write-temp -> fsync -> rename protocol must make unobservable."""
-
-    def __init__(self, seed: int = 0, fail_rate: float = 0.5):
-        self._rng = random.Random(seed)
-        self.fail_rate = fail_rate
-        self.writes = 0
-        self.torn = 0
+class _RealDisk:
+    """The default delegation target: plain durable IO with the same
+    fsync discipline as ``train.checkpoint.CheckpointIO`` (kept local so
+    importing injectors never drags in jax/orbax)."""
 
     def write_bytes(self, path: Path, data: bytes) -> None:
-        self.writes += 1
-        if self._rng.random() < self.fail_rate:
-            self.torn += 1
-            Path(path).write_bytes(data[: max(1, len(data) // 2)])
-            raise OSError("injected torn write")
         with open(path, "wb") as fh:
             fh.write(data)
             fh.flush()
@@ -277,18 +269,78 @@ class TornDisk:
         return Path(path).read_bytes()
 
 
-class ManifestCrashDisk:
-    """CheckpointIO-compatible disk that dies exactly at the manifest
-    write once :meth:`arm`\\ ed — the async sharded writer's commit point
+class DiskInjector:
+    """Base for CheckpointIO-compatible disk injectors: the uniform
+    ``wrap()`` seam.
+
+    Every disk injector runs its fault logic at the OUTER layer and
+    delegates the raw bytes to ``inner`` (a real durable disk by
+    default), so two faults stack deterministically and order is
+    explicit::
+
+        io = SlowDisk(clock).wrap(TornDisk(seed=1))   # outermost first
+
+    reads "consume latency, then roll for a torn write".  ``wrap``
+    re-points the delegation and returns ``self``, so stacks compose
+    fluently and the outermost injector is handed to the checkpointer.
+    """
+
+    def __init__(self, inner: Any | None = None):
+        self.inner: Any = inner if inner is not None else _RealDisk()
+
+    def wrap(self, inner: Any) -> "DiskInjector":
+        """Delegate raw IO to ``inner`` (another injector or a real
+        CheckpointIO); returns self so stacks read outermost-first."""
+        self.inner = inner
+        return self
+
+    def write_bytes(self, path: Path, data: bytes) -> None:
+        self.inner.write_bytes(path, data)
+
+    def replace(self, src: Path, dst: Path) -> None:
+        self.inner.replace(src, dst)
+
+    def read_bytes(self, path: Path) -> bytes:
+        return self.inner.read_bytes(path)
+
+
+class TornDisk(DiskInjector):
+    """Torn-write disk: seeded writes persist only a prefix of the bytes
+    (through the inner disk), then raise OSError — the fault the atomic
+    write-temp -> fsync -> rename protocol must make unobservable."""
+
+    def __init__(self, seed: int = 0, fail_rate: float = 0.5, inner: Any | None = None):
+        super().__init__(inner)
+        self._rng = random.Random(seed)
+        self.fail_rate = fail_rate
+        self.writes = 0
+        self.torn = 0
+
+    def write_bytes(self, path: Path, data: bytes) -> None:
+        self.writes += 1
+        if self._rng.random() < self.fail_rate:
+            self.torn += 1
+            self.inner.write_bytes(path, data[: max(1, len(data) // 2)])
+            raise OSError("injected torn write")
+        self.inner.write_bytes(path, data)
+
+
+class ManifestCrashDisk(DiskInjector):
+    """Disk that dies exactly at the manifest write once :meth:`arm`\\ ed
+    — the async sharded writer's commit point
     (train/datastream.AsyncShardedCheckpointer writes every shard file,
     THEN the manifest).  Shard files written before the crash land
     normally, so the fault leaves realistic litter on disk; the manifest
     never lands, so ``restore_latest`` must fall back to the previous
     checkpoint untouched.  Deterministic by construction — no RNG, the
-    crash fires on the first armed manifest write."""
+    crash fires on the first armed manifest write.  With ``once=True``
+    (the default) the crash disarms itself after firing, so a run that
+    keeps checkpointing past the incident recovers on the next save."""
 
-    def __init__(self, marker: str = "manifest"):
+    def __init__(self, marker: str = "manifest", once: bool = True, inner: Any | None = None):
+        super().__init__(inner)
         self.marker = marker
+        self.once = once
         self.armed = False
         self.crashes = 0
         self.writes = 0
@@ -300,25 +352,19 @@ class ManifestCrashDisk:
         self.writes += 1
         if self.armed and self.marker in Path(path).name:
             self.crashes += 1
+            if self.once:
+                self.armed = False
             raise OSError("injected writer crash at the manifest commit point")
-        with open(path, "wb") as fh:
-            fh.write(data)
-            fh.flush()
-            os.fsync(fh.fileno())
-
-    def replace(self, src: Path, dst: Path) -> None:
-        os.replace(src, dst)
-
-    def read_bytes(self, path: Path) -> bytes:
-        return Path(path).read_bytes()
+        self.inner.write_bytes(path, data)
 
 
-class SlowDisk:
-    """CheckpointIO-compatible slow disk: every write consumes
-    ``latency_s`` of injected-clock time before landing (virtually slow,
-    wall-clock instant)."""
+class SlowDisk(DiskInjector):
+    """Slow disk: every write consumes ``latency_s`` of injected-clock
+    time before the inner disk lands it (virtually slow, wall-clock
+    instant)."""
 
-    def __init__(self, clock: Clock, latency_s: float = 5.0):
+    def __init__(self, clock: Clock, latency_s: float = 5.0, inner: Any | None = None):
+        super().__init__(inner)
         self.clock = clock
         self.latency_s = latency_s
         self.writes = 0
@@ -326,13 +372,4 @@ class SlowDisk:
     def write_bytes(self, path: Path, data: bytes) -> None:
         self.writes += 1
         self.clock.sleep(self.latency_s)
-        with open(path, "wb") as fh:
-            fh.write(data)
-            fh.flush()
-            os.fsync(fh.fileno())
-
-    def replace(self, src: Path, dst: Path) -> None:
-        os.replace(src, dst)
-
-    def read_bytes(self, path: Path) -> bytes:
-        return Path(path).read_bytes()
+        self.inner.write_bytes(path, data)

@@ -54,6 +54,12 @@ Catalog:
   stream cursor) must reproduce the unbroken run's loss sequence
   bit-identically, and a writer crashed at the manifest commit point
   must leave the previous checkpoint fully restorable.
+* ``gauntlet`` — the composed incident (chaos/gauntlet.py): slice loss
+  + broker shard failover in the SAME reshard pause + a writer crash
+  at the manifest commit point, against ONE end-to-end workload, with
+  the cross-subsystem invariants (exactly-once records, loss
+  continuity, zero restarts, torn-write restorability, exactly-once
+  alert transitions) checked together.
 """
 
 from __future__ import annotations
@@ -74,9 +80,20 @@ from deeplearning_cfn_tpu.chaos.injectors import (
 from deeplearning_cfn_tpu.utils.timeouts import FakeClock
 
 
+#: Bump when the report wire shape changes.  v1 had no version field;
+#: v2 added ``schema_version`` + the ``faults`` block, so gauntlet and
+#: legacy scenario reports stay machine-diffable.
+REPORT_SCHEMA_VERSION = 2
+
+
 @dataclass
 class ScenarioReport:
-    """What a scenario proved (and what it could not)."""
+    """What a scenario proved (and what it could not).
+
+    ``faults`` is the declarative fault block: one dict per injected
+    fault (``{"kind", "at_step", ...}``), empty for legacy scenarios
+    whose faults are implicit in the scenario body.
+    """
 
     name: str
     seed: int
@@ -84,6 +101,8 @@ class ScenarioReport:
     invariants: list[str] = field(default_factory=list)
     violations: list[str] = field(default_factory=list)
     details: dict[str, Any] = field(default_factory=dict)
+    faults: list[dict[str, Any]] = field(default_factory=list)
+    schema_version: int = REPORT_SCHEMA_VERSION
 
     def check(self, condition: bool, description: str) -> None:
         if condition:
@@ -94,12 +113,14 @@ class ScenarioReport:
 
     def to_dict(self) -> dict[str, Any]:
         return {
+            "schema_version": self.schema_version,
             "scenario": self.name,
             "seed": self.seed,
             "passed": self.passed,
             "invariants": list(self.invariants),
             "violations": list(self.violations),
             "details": dict(self.details),
+            "faults": [dict(f) for f in self.faults],
         }
 
 
@@ -2865,6 +2886,20 @@ def sched_flash_crowd(seed: int) -> ScenarioReport:
     return report
 
 
+# --- gauntlet ----------------------------------------------------------------
+
+
+def gauntlet(seed: int) -> ScenarioReport:
+    """Composed multi-fault incident: slice loss + broker shard failover
+    in the SAME reshard pause + a writer crash at the manifest commit
+    point, against one end-to-end workload — the cross-subsystem
+    invariants no single-subsystem scenario can see (chaos/gauntlet.py).
+    """
+    from deeplearning_cfn_tpu.chaos.gauntlet import pinned_schedule, run_gauntlet
+
+    return run_gauntlet(pinned_schedule(seed))
+
+
 SCENARIOS: dict[str, Callable[[int], ScenarioReport]] = {
     "silent-death": silent_death,
     "partition": partition,
@@ -2880,6 +2915,37 @@ SCENARIOS: dict[str, Callable[[int], ScenarioReport]] = {
     "degraded-pair-heal": degraded_pair_heal,
     "alert-storm": alert_storm,
     "sched-flash-crowd": sched_flash_crowd,
+    "gauntlet": gauntlet,
+}
+# Pinned gauntlet regression reproducers (chaos/gauntlet.py
+# REGRESSION_SCHEDULES) register themselves into SCENARIOS and
+# SCENARIO_FAULTS when chaos.gauntlet is imported — the package
+# __init__ always imports it, so `dlcfn chaos --all`, test_chaos's
+# parametrization, and the DLC610 replay audit all see them.
+
+#: Fault vocabulary per scenario — the seams each one injects into,
+#: printed by ``dlcfn chaos --list`` next to the description.
+SCENARIO_FAULTS: dict[str, tuple[str, ...]] = {
+    "silent-death": ("silent-death",),
+    "partition": ("partition", "message-chaos"),
+    "flaky-rpc": ("http-errors", "connection-reset", "hard-down"),
+    "slow-disk": ("torn-write", "slow-write"),
+    "slice-loss-live": ("slice-loss", "forced-fallback"),
+    "data-reshard-live": ("slice-loss", "writer-crash"),
+    "straggler": ("straggler",),
+    "serve-replica-loss": ("replica-loss",),
+    "broker-failover": ("broker-failover",),
+    "split-brain": ("partition", "split-brain"),
+    "shard-failover": ("shard-failover", "silent-death", "split-brain"),
+    "degraded-pair-heal": ("broker-failover",),
+    "alert-storm": ("silent-death", "straggler", "broker-failover"),
+    "sched-flash-crowd": ("flash-crowd", "replica-loss", "preemption"),
+    "gauntlet": (
+        "slice-loss",
+        "shard-failover",
+        "writer-crash",
+        "telemetry-blackout",
+    ),
 }
 
 

@@ -810,6 +810,19 @@ def _status_datastream(args) -> dict | None:
     return fold_datastream_events(read_journal(args.journal, kind="datastream")) or None
 
 
+def _status_gauntlet(args) -> dict | None:
+    """The composed-incident gauntlet's run/sweep verdicts (runs, last
+    run's pass/violations, last sweep's seeds/failures) folded from
+    journaled ``gauntlet`` events, or None (no journal / no gauntlet).
+    Feeds the ``dlcfn_gauntlet_*`` gauges in the Prometheus rendering."""
+    if not args.journal:
+        return None
+    from deeplearning_cfn_tpu.obs.exporter import fold_gauntlet_events
+    from deeplearning_cfn_tpu.obs.recorder import read_journal
+
+    return fold_gauntlet_events(read_journal(args.journal, kind="gauntlet")) or None
+
+
 def _status_fleet(args, liveness) -> dict | None:
     """Fleet-merged agent telemetry from the broker's TELEM table, or
     None (``--fleet`` not passed / no broker source / dial failure).
@@ -948,6 +961,7 @@ def cmd_status(args) -> int:
     comms = _status_comms(args)
     replay = _status_replay(args)
     datastream = _status_datastream(args)
+    gauntlet = _status_gauntlet(args)
     fleet = _status_fleet(args, liveness)
     workers = _status_metrics(args.metrics_dir) if args.metrics_dir else None
     if args.metrics_dir and workers is None:
@@ -971,6 +985,7 @@ def cmd_status(args) -> int:
                 fleet=fleet,
                 datastream=datastream,
                 replay=replay,
+                gauntlet=gauntlet,
             ),
             end="",
         )
@@ -987,6 +1002,7 @@ def cmd_status(args) -> int:
         and comms is None
         and replay is None
         and datastream is None
+        and gauntlet is None
         and fleet is None
     ):
         # Metrics-only: the original (round-4) output shape, unchanged.
@@ -1015,6 +1031,8 @@ def cmd_status(args) -> int:
         out["replay"] = replay
     if datastream is not None:
         out["datastream"] = datastream
+    if gauntlet is not None:
+        out["gauntlet"] = gauntlet
     if fleet is not None:
         out["fleet"] = fleet
     if workers is not None:
@@ -1515,12 +1533,15 @@ def cmd_chaos(args) -> int:
         os.environ["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8"
         ).strip()
-    from deeplearning_cfn_tpu.chaos import SCENARIOS, run_scenario
+    from deeplearning_cfn_tpu.chaos import SCENARIO_FAULTS, SCENARIOS, run_scenario
 
     if args.list_scenarios:
+        width = max(len(name) for name in SCENARIOS)
         for name in sorted(SCENARIOS):
             doc = (SCENARIOS[name].__doc__ or "").strip().split("\n")[0]
-            print(f"{name:14s} {doc}")
+            faults = ", ".join(SCENARIO_FAULTS.get(name, ())) or "-"
+            print(f"{name:<{width}}  {doc}")
+            print(f"{'':<{width}}  faults: {faults}")
         return 0
     names = sorted(SCENARIOS) if args.all else [args.scenario]
     if names == [None]:
@@ -1537,6 +1558,40 @@ def cmd_chaos(args) -> int:
     payload = [r.to_dict() for r in reports]
     print(json.dumps(payload[0] if len(payload) == 1 else payload, indent=2))
     return 0 if all(r.passed for r in reports) else 1
+
+
+def cmd_gauntlet(args) -> int:
+    """dlcfn gauntlet: composed multi-fault incidents over the real
+    end-to-end stack (chaos/gauntlet.py, docs/RESILIENCE.md).
+
+    Default runs the pinned 3-fault schedule for --seed and prints the
+    report; ``--sweep N`` runs the seeded incident explorer over N
+    perturbed schedules, shrinking any failure to a minimal reproducer.
+    Exit 1 on any invariant violation / failing schedule."""
+    # Same backend-init ordering constraint as cmd_chaos: the gauntlet
+    # drives a real 8-device SPMD trainer, so the flag must land before
+    # JAX first initializes.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    from deeplearning_cfn_tpu.chaos import (
+        pinned_schedule,
+        run_gauntlet,
+        run_gauntlet_sweep,
+    )
+
+    if args.sweep is not None:
+        if args.sweep < 1:
+            print("dlcfn gauntlet: --sweep needs at least 1 seed")
+            return 2
+        summary = run_gauntlet_sweep(n_seeds=args.sweep, base_seed=args.seed)
+        print(json.dumps(summary, indent=2))
+        return 0 if not summary["failures"] else 1
+    report = run_gauntlet(pinned_schedule(args.seed))
+    print(json.dumps(report.to_dict(), indent=2))
+    return 0 if report.passed else 1
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -1831,6 +1886,19 @@ def main(argv: list[str] | None = None) -> int:
     px.add_argument("--list", action="store_true", dest="list_scenarios",
                     help="list scenarios and exit")
     px.set_defaults(fn=cmd_chaos)
+    pg = sub.add_parser(
+        "gauntlet",
+        help="run composed multi-fault incidents with cross-subsystem "
+        "invariants (chaos gauntlet)",
+    )
+    pg.add_argument("--seed", type=int, default=0,
+                    help="schedule seed (pinned run) or sweep base seed; "
+                         "reports are byte-deterministic per seed")
+    pg.add_argument("--sweep", type=int, default=None, metavar="N",
+                    help="explore N perturbed fault schedules instead of "
+                         "the pinned 3-fault incident, shrinking any "
+                         "failure to a minimal reproducer")
+    pg.set_defaults(fn=cmd_gauntlet)
     args = parser.parse_args(argv)
     return args.fn(args)
 

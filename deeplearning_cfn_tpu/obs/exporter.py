@@ -61,6 +61,14 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
     "dlcfn_replay_cases": ("gauge", "Cases (chaos scenarios + fleet soaks) double-run by the last replay audit."),
     "dlcfn_replay_divergent": ("gauge", "Cases whose same-seed double runs produced different report bytes."),
     "dlcfn_replay_clean": ("gauge", "1 when the last replay audit was byte-identical everywhere, else 0."),
+    # chaos gauntlet (chaos/gauntlet.py, docs/RESILIENCE.md)
+    "dlcfn_gauntlet_runs_total": ("counter", "Composed-incident gauntlet runs journaled."),
+    "dlcfn_gauntlet_passed": ("gauge", "1 when the last gauntlet run held every cross-subsystem invariant, else 0."),
+    "dlcfn_gauntlet_faults_injected": ("gauge", "Fault events in the last gauntlet run's schedule."),
+    "dlcfn_gauntlet_violations": ("gauge", "Invariant violations in the last gauntlet run."),
+    "dlcfn_gauntlet_sweep_seeds": ("gauge", "Seeds explored by the last gauntlet incident sweep."),
+    "dlcfn_gauntlet_sweep_failures": ("gauge", "Failing schedules found by the last gauntlet incident sweep."),
+    "dlcfn_gauntlet_broker_degraded_pairs": ("gauge", "Broker shard pairs not fully healed during a gauntlet incident (drives the gauntlet SLO rule)."),
     # broker control plane
     "dlcfn_broker_role": ("gauge", "Broker role per node (1 = primary, 0 = standby)."),
     "dlcfn_broker_epoch": ("gauge", "Leadership term the node is fenced to."),
@@ -239,6 +247,32 @@ def fold_replay_events(events) -> dict[str, Any]:
     return out
 
 
+def fold_gauntlet_events(events) -> dict[str, Any]:
+    """Fold flight-journal ``gauntlet`` events (composed-incident runs
+    and incident-explorer sweeps from ``chaos/gauntlet.py``) into the
+    counters ``dlcfn status`` and the ``dlcfn_gauntlet_*`` gauges
+    surface.  Runs count; the newest run and the newest sweep summary
+    win.  Empty dict when no gauntlet ever ran."""
+    out: dict[str, Any] = {"runs_total": 0, "last_run": None, "sweep": None}
+    saw = False
+    for event in events:
+        if event.get("kind") != "gauntlet":
+            continue
+        saw = True
+        name = event.get("event")
+        if name == "run":
+            out["runs_total"] += 1
+            out["last_run"] = {
+                k: event.get(k)
+                for k in ("seed", "passed", "faults", "violations")
+            }
+        elif name == "sweep":
+            out["sweep"] = {
+                k: event.get(k) for k in ("seeds", "base_seed", "failures")
+            }
+    return out if saw else {}
+
+
 def fold_datastream_events(events) -> dict[str, Any]:
     """Fold flight-journal ``datastream`` events (data-plane progress,
     reshards, async-checkpoint writes, loader fallbacks) into the
@@ -363,6 +397,7 @@ def render_prometheus(
     datastream: Mapping[str, Any] | None = None,
     sched: Mapping[str, Any] | None = None,
     replay: Mapping[str, Any] | None = None,
+    gauntlet: Mapping[str, Any] | None = None,
 ) -> str:
     """Render liveness snapshot + span aggregates + input-pipeline
     counters as Prometheus text.
@@ -386,7 +421,9 @@ def render_prometheus(
     counters); ``sched`` is ``fold_sched_events()`` (the fleet
     arbiter's decision/preemption/loan counters); ``replay`` is
     ``fold_replay_events()`` (the replay-audit sentinel's double-run
-    byte-determinism verdict).  Any may be None/empty.
+    byte-determinism verdict); ``gauntlet`` is
+    ``fold_gauntlet_events()`` (the composed-incident gauntlet's
+    run/sweep verdicts).  Any may be None/empty.
     """
     lines: list[str] = []
     seen: set[str] = set()
@@ -584,6 +621,42 @@ def render_prometheus(
             f"dlcfn_replay_clean{_labels(cluster=cluster)} "
             f"{1 if replay.get('clean') else 0}"
         )
+    if gauntlet:
+        head("dlcfn_gauntlet_runs_total")
+        lines.append(
+            f"dlcfn_gauntlet_runs_total{_labels(cluster=cluster)} "
+            f"{gauntlet.get('runs_total', 0)}"
+        )
+        last_run = gauntlet.get("last_run")
+        if last_run:
+            labels = _labels(cluster=cluster, seed=last_run.get("seed"))
+            head("dlcfn_gauntlet_passed")
+            lines.append(
+                f"dlcfn_gauntlet_passed{labels} "
+                f"{1 if last_run.get('passed') else 0}"
+            )
+            head("dlcfn_gauntlet_faults_injected")
+            lines.append(
+                f"dlcfn_gauntlet_faults_injected{labels} "
+                f"{last_run.get('faults') or 0}"
+            )
+            head("dlcfn_gauntlet_violations")
+            lines.append(
+                f"dlcfn_gauntlet_violations{labels} "
+                f"{last_run.get('violations') or 0}"
+            )
+        sweep = gauntlet.get("sweep")
+        if sweep:
+            head("dlcfn_gauntlet_sweep_seeds")
+            lines.append(
+                f"dlcfn_gauntlet_sweep_seeds{_labels(cluster=cluster)} "
+                f"{sweep.get('seeds') or 0}"
+            )
+            head("dlcfn_gauntlet_sweep_failures")
+            lines.append(
+                f"dlcfn_gauntlet_sweep_failures{_labels(cluster=cluster)} "
+                f"{sweep.get('failures') or 0}"
+            )
     if broker:
         for name in ("dlcfn_broker_role", "dlcfn_broker_epoch", "dlcfn_broker_up"):
             head(name)

@@ -47,10 +47,27 @@ reports = json.load(open("/tmp/_chaos.json"))
 names = {r["scenario"] for r in reports}
 for required in ("serve-replica-loss", "broker-failover", "split-brain",
                  "shard-failover", "degraded-pair-heal",
-                 "alert-storm", "data-reshard-live", "sched-flash-crowd"):
+                 "alert-storm", "data-reshard-live", "sched-flash-crowd",
+                 "gauntlet"):
     assert required in names, f"{required} missing from {sorted(names)}"
 EOF
 echo "chaos: all scenarios held their invariants (report: /tmp/_chaos.json)"
+
+echo "== chaos gauntlet (composed multi-fault incident + seeded sweep) =="
+# The composed-incident gate no single-subsystem scenario can see: the
+# pinned 3-fault schedule (slice loss mid-epoch, broker shard failover
+# in the SAME reshard pause, writer crash mid-manifest) must hold every
+# cross-subsystem invariant, then a small seeded sweep perturbs fault
+# timing/ordering and shrinks any failure to a minimal reproducer
+# (docs/RESILIENCE.md, "Composed incidents").  Wall-budgeted: the
+# full 20-seed explorer lives in tests/test_gauntlet.py -m slow.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  timeout -k 10 420 python -m deeplearning_cfn_tpu.cli gauntlet --seed 0 \
+  > /tmp/_gauntlet.json || { cat /tmp/_gauntlet.json; exit 1; }
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  timeout -k 10 420 python -m deeplearning_cfn_tpu.cli gauntlet --sweep 4 --seed 100 \
+  > /tmp/_gauntlet_sweep.json || { cat /tmp/_gauntlet_sweep.json; exit 1; }
+echo "gauntlet: pinned incident + 4-seed sweep held every cross-subsystem invariant (reports: /tmp/_gauntlet.json, /tmp/_gauntlet_sweep.json)"
 
 echo "== SLO rule schema (obs/slo.py DEFAULT_RULES vs METRIC_REGISTRY) =="
 # Every shipped alert rule must parse and reference a registered

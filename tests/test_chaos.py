@@ -15,20 +15,34 @@ from deeplearning_cfn_tpu.chaos import (
     SCENARIOS,
     ChaosQueue,
     FlakyOpener,
+    ManifestCrashDisk,
+    SlowDisk,
     TornDisk,
     run_scenario,
 )
 from deeplearning_cfn_tpu.cluster.queue import InMemoryQueue
 from deeplearning_cfn_tpu.utils.timeouts import FakeClock
 
-ALL = sorted(SCENARIOS)
+# The composed-incident gauntlet has its own suite (tests/test_gauntlet.py)
+# and still runs here via check.sh's `chaos --all` + replay-audit stages;
+# re-running its full SPMD workload per catalog seed would blow the tier-1
+# wall budget for coverage the dedicated suite already pins.
+ALL = sorted(n for n in SCENARIOS if n != "gauntlet")
 
 
 # --- the catalog -------------------------------------------------------------
 
 
-@pytest.mark.parametrize("name", ALL)
-@pytest.mark.parametrize("seed", [0, 1])
+# The heavyweight scenarios (real multi-device SPMD training inside) run one
+# seed in tier-1 and their second seed in the slow lane below — check.sh's
+# `chaos --all` and replay-audit stages exercise them every run regardless.
+_HEAVY = {"sched-flash-crowd", "slice-loss-live", "data-reshard-live"}
+_CASES = [(n, s) for n in ALL for s in ((0,) if n in _HEAVY else (0, 1))]
+
+
+@pytest.mark.parametrize(
+    "name,seed", _CASES, ids=[f"{n}-{s}" for n, s in _CASES]
+)
 def test_scenario_invariants_hold(name, seed):
     report = run_scenario(name, seed)
     assert report.passed, f"{name} seed={seed}: {report.violations}"
@@ -36,6 +50,21 @@ def test_scenario_invariants_hold(name, seed):
     assert not report.violations
 
 
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(_HEAVY))
+def test_heavy_scenario_invariants_hold_second_seed(name):
+    report = run_scenario(name, seed=1)
+    assert report.passed, f"{name} seed=1: {report.violations}"
+    assert report.invariants
+    assert not report.violations
+
+
+# Byte-determinism per scenario is ALSO pinned on every check.sh run by the
+# replay-audit stage (scripts/replay_audit.py double-runs the whole catalog
+# and diffs the reports), so the in-process doubles ride the slow lane — on
+# the single-core CI host a second full run of every scenario was the
+# difference between tier-1 fitting its wall budget and timing out.
+@pytest.mark.slow
 @pytest.mark.parametrize("name", ALL)
 def test_scenario_reports_deterministic_per_seed(name):
     first = run_scenario(name, seed=0).to_dict()
@@ -151,6 +180,56 @@ def test_atomic_write_survives_interrupted_replace(tmp_path):
     assert target.read_bytes() == b"v1"
     atomic_write_bytes(target, b"v2")
     assert target.read_bytes() == b"v2"
+
+
+def test_disk_injectors_stack_deterministically(tmp_path):
+    # wrap() order IS the fault order, outermost first.  SlowDisk over an
+    # armed ManifestCrashDisk: the latency is consumed, THEN the manifest
+    # write crashes at the inner layer.
+    clock = FakeClock()
+    crash = ManifestCrashDisk()
+    crash.arm()
+    stack = SlowDisk(clock, latency_s=5.0).wrap(crash)
+    with pytest.raises(OSError, match="manifest"):
+        stack.write_bytes(tmp_path / "ckpt-1.manifest.json", b"m")
+    assert clock.now() == 5.0
+    assert crash.crashes == 1
+    # Reversed stack: the crash fires at the OUTER layer before the slow
+    # disk ever sees the write — zero latency consumed.
+    clock2 = FakeClock()
+    crash2 = ManifestCrashDisk()
+    crash2.arm()
+    stack2 = crash2.wrap(SlowDisk(clock2, latency_s=5.0))
+    with pytest.raises(OSError, match="manifest"):
+        stack2.write_bytes(tmp_path / "ckpt-2.manifest.json", b"m")
+    assert clock2.now() == 0.0
+    assert crash2.crashes == 1
+
+
+def test_torn_over_slow_stack_counts_both_layers(tmp_path):
+    clock = FakeClock()
+    slow = SlowDisk(clock, latency_s=2.0)
+    torn = TornDisk(seed=0, fail_rate=1.0).wrap(slow)
+    with pytest.raises(OSError, match="torn"):
+        torn.write_bytes(tmp_path / "shard-0.bin", b"x" * 8)
+    # The torn prefix still travels through the inner slow disk: both
+    # layers count the write, the latency lands, and only the half-file
+    # reaches the platters.
+    assert torn.writes == 1 and torn.torn == 1
+    assert slow.writes == 1 and clock.now() == 2.0
+    assert (tmp_path / "shard-0.bin").read_bytes() == b"x" * 4
+
+
+def test_manifest_crash_once_disarms_and_recovers(tmp_path):
+    disk = ManifestCrashDisk()  # once=True default
+    disk.arm()
+    with pytest.raises(OSError):
+        disk.write_bytes(tmp_path / "ckpt-3.manifest.json", b"v3")
+    # Disarmed after firing: the next manifest commit lands — the
+    # gauntlet relies on this to let the async writer recover mid-run.
+    disk.write_bytes(tmp_path / "ckpt-4.manifest.json", b"v4")
+    assert (tmp_path / "ckpt-4.manifest.json").read_bytes() == b"v4"
+    assert disk.crashes == 1
 
 
 # --- soak (excluded from tier-1 by the slow mark) ---------------------------

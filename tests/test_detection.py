@@ -155,8 +155,16 @@ class TestBackboneTransfer:
     way the reference starts Mask R-CNN from ImageNet-R50-AlignPadding
     (run.sh:94, prepare-s3-bucket.sh:33-36)."""
 
+    # One classifier train pays for every test in the class: the ckpt is a
+    # pure function of fixed keys + synthetic data, and on the single-core
+    # CI host each redundant train is ~15s of recompilation.
+    _ckpt_cache: dict = {}
+
     def _classifier_ckpt(self, tmp_path, steps=2):
         """Train a tiny ResNet classifier briefly and checkpoint it."""
+        cached = type(self)._ckpt_cache.get(steps)
+        if cached is not None:
+            return cached
         from deeplearning_cfn_tpu.models.resnet import ResNet
         from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
         from deeplearning_cfn_tpu.train.checkpoint import Checkpointer
@@ -178,7 +186,8 @@ class TestBackboneTransfer:
                             async_save=False)
         ckpt.save(steps, state)
         ckpt.close()
-        return tmp_path / "cls-ckpt", state
+        type(self)._ckpt_cache[steps] = (tmp_path / "cls-ckpt", state)
+        return type(self)._ckpt_cache[steps]
 
     def test_transfer_copies_backbone_and_keeps_heads(self, tmp_path):
         from deeplearning_cfn_tpu.train.checkpoint import Checkpointer

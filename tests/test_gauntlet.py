@@ -1,0 +1,254 @@
+"""Composed-incident gauntlet regression harness (chaos/gauntlet.py).
+
+The pinned 3-fault incident runs via the scenario catalog in
+test_chaos.py; here the pairwise fault matrix proves every two-fault
+composition holds the cross-subsystem invariants byte-deterministically,
+the schedule validator rejects un-assertable incidents, the shrinker
+produces a stable minimal reproducer, and the sweep explorer / exporter
+fold / CLI surfaces behave.  Real gauntlet runs drive a real 8-device
+SPMD trainer on a virtual clock — seconds each, not minutes.
+"""
+
+import json
+
+import pytest
+
+from deeplearning_cfn_tpu.chaos import (
+    FAULT_KINDS,
+    FaultEvent,
+    FaultSchedule,
+    perturbed_schedule,
+    pinned_schedule,
+    run_gauntlet,
+    run_gauntlet_sweep,
+    shrink_schedule,
+)
+
+# One known-good placement per kind for the pairwise matrix: slice loss
+# mid-epoch, an (uncomposed) failover early, the writer crash after the
+# reshard settles, the blackout late enough to not swallow the alert's
+# firing window (validate() enforces all of this).
+_AT = {
+    "slice-loss": 4,
+    "shard-failover": 2,
+    "writer-crash": 6,
+    "telemetry-blackout": 8,
+}
+
+PAIRS = [
+    (a, b)
+    for i, a in enumerate(FAULT_KINDS)
+    for b in FAULT_KINDS[i + 1 :]
+]
+
+
+def _event(kind: str) -> FaultEvent:
+    return FaultEvent(
+        kind,
+        at_step=_AT[kind],
+        duration=2 if kind == "telemetry-blackout" else 0,
+        shard=1 if kind == "shard-failover" else 0,
+    )
+
+
+def _pair_schedule(a: str, b: str, seed: int = 0) -> FaultSchedule:
+    kinds = sorted((a, b), key=FAULT_KINDS.index)
+    return FaultSchedule(seed=seed, events=tuple(_event(k) for k in kinds))
+
+
+# --- pairwise composition matrix --------------------------------------------
+
+
+# Each pair is two full end-to-end gauntlet runs (~12s); the 12-run matrix
+# lives in the slow lane beside the 20-seed sweep so tier-1 stays inside its
+# wall budget. Tier-1 still composes three faults through the pinned CLI run
+# below, and check.sh double-runs the pinned schedule plus a randomized sweep.
+@pytest.mark.slow
+@pytest.mark.parametrize("a,b", PAIRS, ids=[f"{a}+{b}" for a, b in PAIRS])
+def test_pairwise_composition_holds_and_is_byte_deterministic(a, b):
+    schedule = _pair_schedule(a, b)
+    assert not schedule.validate()
+    first = run_gauntlet(schedule)
+    assert first.passed, f"{a}+{b}: {first.violations}"
+    assert first.invariants
+    second = run_gauntlet(schedule)
+    d1, d2 = first.to_dict(), second.to_dict()
+    assert d1 == d2
+    assert json.dumps(d1, sort_keys=True) == json.dumps(d2, sort_keys=True)
+    # The report's fault block names exactly the scheduled vocabulary.
+    assert [f["kind"] for f in d1["faults"]] == sorted(
+        (a, b), key=FAULT_KINDS.index
+    )
+
+
+# --- schedule validation ----------------------------------------------------
+
+
+def test_validate_rejects_unassertable_schedules():
+    ok = pinned_schedule(0)
+    assert not ok.validate()
+
+    def errs(events, **kw):
+        return FaultSchedule(seed=0, events=tuple(events), **kw).validate()
+
+    # Duplicate kinds: composition is across subsystems, not repetition.
+    assert errs([_event("slice-loss"), _event("slice-loss")])
+    # Unknown vocabulary.
+    assert errs([FaultEvent("disk-on-fire", at_step=3)])
+    # Too short to hold a loss prefix + alert lifecycle.
+    assert errs([_event("slice-loss")], total_steps=7)
+    # Slice loss too late to prove post-reshard continuity.
+    assert errs([FaultEvent("slice-loss", at_step=10)])
+    # Writer crash before the reshard pause inverts the incident.
+    assert errs(
+        [FaultEvent("slice-loss", at_step=4), FaultEvent("writer-crash", at_step=3)]
+    )
+    # Failover shard outside the ring.
+    assert errs([FaultEvent("shard-failover", at_step=2, shard=5)])
+    # Blackout that would swallow the failover alert's firing window.
+    assert errs(
+        [
+            FaultEvent("shard-failover", at_step=2),
+            FaultEvent("telemetry-blackout", at_step=3, duration=2),
+        ]
+    )
+
+
+def test_run_gauntlet_refuses_invalid_schedule():
+    bad = FaultSchedule(
+        seed=0, events=(FaultEvent("slice-loss", at_step=0),)
+    )
+    with pytest.raises(ValueError, match="slice-loss"):
+        run_gauntlet(bad)
+
+
+def test_schedule_roundtrips_through_dict():
+    for seed in range(6):
+        sched = perturbed_schedule(seed)
+        assert not sched.validate(), (seed, sched.validate())
+        assert FaultSchedule.from_dict(sched.to_dict()) == sched
+    assert perturbed_schedule(3) == perturbed_schedule(3)
+
+
+# --- the shrinker -----------------------------------------------------------
+
+
+class _StubReport:
+    def __init__(self, passed: bool):
+        self.passed = passed
+        self.violations = [] if passed else ["stub violation"]
+
+
+def test_shrinker_produces_stable_minimal_schedule():
+    # Synthetic failure: the incident reproduces iff writer-crash and
+    # shard-failover are BOTH present (a cross-subsystem interaction),
+    # seeded from the full 4-fault schedule.
+    full = FaultSchedule(seed=9, events=tuple(_event(k) for k in FAULT_KINDS))
+    assert not full.validate()
+
+    def still_fails(sched: FaultSchedule) -> bool:
+        kinds = {e.kind for e in sched.events}
+        return {"writer-crash", "shard-failover"} <= kinds
+
+    minimal = shrink_schedule(full, still_fails)
+    assert [e.kind for e in minimal.events] == ["shard-failover", "writer-crash"]
+    assert not minimal.validate()  # every shrink step stays runnable
+    # Deterministic: same input, same reproducer.
+    assert shrink_schedule(full, still_fails) == minimal
+
+
+def test_sweep_shrinks_failures_with_stub_runner():
+    def runner(sched: FaultSchedule) -> _StubReport:
+        kinds = {e.kind for e in sched.events}
+        return _StubReport(
+            passed=not {"writer-crash", "shard-failover"} <= kinds
+        )
+
+    summary = run_gauntlet_sweep(n_seeds=8, base_seed=0, runner=runner)
+    assert summary["seeds"] == 8
+    assert summary["passed"] + len(summary["failures"]) == 8
+    for failure in summary["failures"]:
+        shrunk_kinds = [e["kind"] for e in failure["shrunk"]["events"]]
+        assert shrunk_kinds == ["shard-failover", "writer-crash"]
+        assert failure["violations"] == ["stub violation"]
+    # Deterministic end to end (the explorer is a pure function of seed).
+    again = run_gauntlet_sweep(n_seeds=8, base_seed=0, runner=runner)
+    assert summary == again
+
+
+# --- exporter / CLI surfaces ------------------------------------------------
+
+
+def test_gauntlet_journal_folds_into_status_and_prom(tmp_path, capsys):
+    from deeplearning_cfn_tpu.cli import main
+    from deeplearning_cfn_tpu.obs.recorder import FlightRecorder
+
+    path = tmp_path / "flight.jsonl"
+    rec = FlightRecorder(path=path)
+    rec.record("gauntlet", event="run", seed=0, passed=True, faults=3, violations=0)
+    rec.record("gauntlet", event="run", seed=1, passed=False, faults=2, violations=1)
+    rec.record("gauntlet", event="sweep", seeds=20, base_seed=0, failures=0)
+    rec.close()
+
+    assert main(["status", "--journal", str(path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["gauntlet"]["runs_total"] == 2
+    assert out["gauntlet"]["last_run"] == {
+        "seed": 1, "passed": False, "faults": 2, "violations": 1,
+    }
+    assert out["gauntlet"]["sweep"] == {
+        "seeds": 20, "base_seed": 0, "failures": 0,
+    }
+
+    assert main(["status", "--journal", str(path), "--format", "prom"]) == 0
+    text = capsys.readouterr().out
+    assert "dlcfn_gauntlet_runs_total 2" in text
+    assert 'dlcfn_gauntlet_passed{seed="1"} 0' in text
+    assert 'dlcfn_gauntlet_violations{seed="1"} 1' in text
+    assert "dlcfn_gauntlet_sweep_seeds 20" in text
+    assert "dlcfn_gauntlet_sweep_failures 0" in text
+
+
+def test_cli_gauntlet_pinned_run(capsys):
+    # The exact invocation check.sh gates on: pinned 3-fault incident,
+    # versioned report with the fault block, exit 0 on a clean run.
+    from deeplearning_cfn_tpu.cli import main
+
+    assert main(["gauntlet", "--seed", "0"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["scenario"] == "gauntlet"
+    assert report["passed"] is True
+    assert report["schema_version"] == 2
+    assert [f["kind"] for f in report["faults"]] == [
+        "slice-loss", "shard-failover", "writer-crash",
+    ]
+
+
+def test_cli_gauntlet_sweep_arg_validation(capsys):
+    from deeplearning_cfn_tpu.cli import main
+
+    assert main(["gauntlet", "--sweep", "0"]) == 2
+    assert "at least 1 seed" in capsys.readouterr().out
+
+
+def test_chaos_list_prints_fault_vocabulary(capsys):
+    from deeplearning_cfn_tpu.chaos import SCENARIO_FAULTS
+    from deeplearning_cfn_tpu.cli import main
+
+    assert main(["chaos", "--list"]) == 0
+    out = capsys.readouterr().out
+    assert "gauntlet" in out
+    assert "faults:" in out
+    assert ", ".join(SCENARIO_FAULTS["gauntlet"]) in out
+
+
+# --- the incident explorer (excluded from tier-1 by the slow mark) ----------
+
+
+@pytest.mark.slow
+def test_sweep_20_seeds_zero_failing_schedules():
+    summary = run_gauntlet_sweep(n_seeds=20, base_seed=0)
+    assert summary["passed"] == 20
+    assert summary["failures"] == []
+    # Every fault kind actually exercised across the sweep.
+    assert all(summary["fault_counts"][k] > 0 for k in FAULT_KINDS)
