@@ -464,6 +464,10 @@ def main() -> None:
             "collective_count": c["collective_count"],
             "collective_bytes_per_step": c["collective_bytes"] // steps_per_call,
             "peak_hbm_bytes": c["peak_hbm_bytes"],
+            # Schedule slack per collective (comms_audit.schedule_overlap)
+            # — how much compute the scheduler has to hide each
+            # collective behind; the DLC512-ratcheted number.
+            "overlap_score": c["overlap_score"],
         }
 
     comms = {

@@ -21,9 +21,10 @@ mesh builders are where axis vocabularies drift first).
 The static half is paired with a dynamic comms-audit sentinel
 (analysis/comms_audit.py) that lowers the real train/serve programs and
 machine-reads their HLO for collectives; its findings use the reserved
-ids DLC510 (comms-budget regression) and DLC511 (unpredicted fsdp
-all-gather) so both halves share one baseline ratchet
-(scripts/lint_baseline.json).
+ids DLC510 (comms-budget regression), DLC511 (unpredicted fsdp
+all-gather), and DLC512 (serialized collective the bucketed overlap
+schedule should hide — overlap_score ratchet) so all halves share one
+baseline ratchet (scripts/lint_baseline.json).
 """
 
 from __future__ import annotations
@@ -58,7 +59,12 @@ RULE_IDS = ("DLC500", "DLC501", "DLC502", "DLC503", "DLC504", "DLC505")
 # the real programs and reading their HLO rather than from this AST pass.
 AUDIT_RULE_BUDGET = "DLC510"
 AUDIT_RULE_UNPREDICTED = "DLC511"
-AUDIT_RULE_IDS = (AUDIT_RULE_BUDGET, AUDIT_RULE_UNPREDICTED)
+AUDIT_RULE_OVERLAP = "DLC512"
+AUDIT_RULE_IDS = (
+    AUDIT_RULE_BUDGET,
+    AUDIT_RULE_UNPREDICTED,
+    AUDIT_RULE_OVERLAP,
+)
 
 # DLC4xx covers the compute tree; comms adds parallel/ — the sharding
 # rule tables and mesh builders author the axis vocabulary everything

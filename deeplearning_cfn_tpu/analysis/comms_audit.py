@@ -19,14 +19,28 @@ three machine signals off each executable:
   fits on a 16 GiB chip.
 
 Each audited program yields a **comms budget**
-``{collective_count, collective_bytes, peak_hbm_bytes}``.  The budget is
-committed (scripts/comms_budget.json) and ratcheted: DLC510 fires when a
-program's collective op count or bytes regress over the committed
-numbers, DLC511 when an fsdp-strategy step contains an all-gather the
-strategy doesn't predict — fsdp shards *parameters*, so the only
-gathers it earns are parameter/optimizer-state shaped; a gather matching
-no train-state leaf means a batch or activation got materialized
-replicated (the classic missing ``with_sharding_constraint``).
+``{collective_count, collective_bytes, peak_hbm_bytes, overlap_score}``.
+The budget is committed (scripts/comms_budget.json) and ratcheted:
+DLC510 fires when a program's collective op count or bytes regress over
+the committed numbers, DLC511 when an fsdp-strategy step contains an
+all-gather the strategy doesn't predict — fsdp shards *parameters*, so
+the only gathers it earns are parameter/optimizer-state shaped; a gather
+matching no train-state leaf means a batch or activation got
+materialized replicated (the classic missing
+``with_sharding_constraint``).
+
+``overlap_score`` machine-reads the optimized *schedule*, not just the
+op set: per computation, every collective issue point is charged the
+number of non-collective ops between it and the next collective
+boundary — the compute the scheduler has available to hide that
+collective behind (for an async pair, the ops between ``-start`` and
+``-done`` fall out of the same walk).  The score is mean slack per
+collective; a bucketed program that issues sync early scores strictly
+higher than the monolithic end-of-backward bundle.  DLC512 ratchets it:
+a score falling below the committed number — or a ``*_overlap``
+program failing to strictly beat its monolithic baseline — is a
+serialized collective that a bucket boundary could hide
+(parallel/overlap.py; docs/PERFORMANCE.md "Hiding the collectives").
 
 Findings are ordinary :class:`Violation`\\ s flowing through the same
 suppression-baseline ratchet as the DLC41x compile audit
@@ -48,6 +62,7 @@ import jax
 
 from deeplearning_cfn_tpu.analysis.collectives import (
     AUDIT_RULE_BUDGET,
+    AUDIT_RULE_OVERLAP,
     AUDIT_RULE_UNPREDICTED,
 )
 from deeplearning_cfn_tpu.analysis.core import Violation
@@ -144,6 +159,84 @@ def hlo_collectives(hlo_text: str) -> list[CollectiveOp]:
     return out
 
 
+# --- the schedule reader (overlap_score) -------------------------------------
+
+# An instruction line is indented and assigns a %-named value; the op
+# name follows the result shape (a single `dtype[..]{..}` token or a
+# parenthesized tuple, which may contain spaces and `/*index=k*/`
+# comments).
+_INSTR_RE = re.compile(r"^\s+(ROOT\s+)?%?[\w.\-]+\s+=\s+")
+_OP_RE = re.compile(r"=\s+(?:\([^)]*\)|\S+)\s+([\w\-]+)\(")
+_COLLECTIVE_NAMES = frozenset(
+    name + suffix
+    for name in COLLECTIVE_OPS
+    for suffix in ("", "-start", "-done")
+)
+
+
+def hlo_computation_ops(hlo_text: str) -> dict[str, list[str]]:
+    """Optimized HLO text -> ordered op names per computation.
+
+    HLO prints instructions in SCHEDULE order inside each computation
+    (`ENTRY`/`%fused`/`%while_body` headers start at column zero and end
+    with ``{``), which is what makes positional slack a faithful read of
+    what the backend will execute between two collectives.
+    """
+    comps: dict[str, list[str]] = {}
+    cur: list[str] | None = None
+    for line in hlo_text.splitlines():
+        if (
+            line.rstrip().endswith("{")
+            and not line.startswith((" ", "\t"))
+            and ("%" in line or line.startswith("ENTRY"))
+        ):
+            cur = comps[line.split("(")[0].strip()] = []
+        elif line.strip() == "}":
+            cur = None
+        elif cur is not None and _INSTR_RE.match(line):
+            m = _OP_RE.search(line)
+            if m:
+                cur.append(m.group(1))
+    return comps
+
+
+def schedule_overlap(hlo_text: str) -> dict:
+    """Mean compute slack per collective across the whole module.
+
+    For every collective ISSUE point (plain or ``-start``; ``-done``
+    halves are not issue points but do act as boundaries), slack is the
+    count of non-collective ops strictly between it and the next
+    collective boundary — or the end of its computation for the last
+    one.  Async pairs need no special case: the ops between ``-start``
+    and ``-done`` are exactly the start's slack.  A slack-0 issue point
+    is a SERIALIZED collective — nothing is scheduled for the backend
+    to hide it behind.
+
+    Returns ``{"overlap_score": float, "serialized_collectives": int,
+    "scheduled_collectives": int}``; score is 0.0 for collective-free
+    programs.
+    """
+    total_slack = 0
+    n_issue = 0
+    n_serialized = 0
+    for ops in hlo_computation_ops(hlo_text).values():
+        idxs = [i for i, op in enumerate(ops) if op in _COLLECTIVE_NAMES]
+        for j, i in enumerate(idxs):
+            if ops[i].endswith("-done"):
+                continue
+            boundary = idxs[j + 1] if j + 1 < len(idxs) else len(ops)
+            slack = boundary - i - 1
+            total_slack += slack
+            n_issue += 1
+            if slack == 0:
+                n_serialized += 1
+    return {
+        "overlap_score": round(total_slack / max(n_issue, 1), 4),
+        "serialized_collectives": n_serialized,
+        "scheduled_collectives": n_issue,
+    }
+
+
 def _peak_hbm_bytes(compiled: Any) -> int:
     """Fold ``memory_analysis()`` into one peak-HBM estimate.
 
@@ -181,10 +274,13 @@ def program_comms(compiled: Any) -> dict:
         by_op[op.op] += 1
         bytes_by_op[op.op] += op.nbytes
     cost = program_cost(compiled)
+    overlap = schedule_overlap(text)
     return {
         "collective_count": len(ops),
         "collective_bytes": sum(op.nbytes for op in ops),
         "peak_hbm_bytes": _peak_hbm_bytes(compiled),
+        "overlap_score": overlap["overlap_score"],
+        "serialized_collectives": overlap["serialized_collectives"],
         "by_op": {k: v for k, v in by_op.items() if v},
         "bytes_by_op": {k: v for k, v in bytes_by_op.items() if v},
         "flops": cost["flops"],
@@ -240,6 +336,11 @@ class ProgramComms:
     bytes_by_op: dict[str, int]
     flops: float | None
     bytes_accessed: float | None
+    # Mean compute slack per collective in the optimized schedule
+    # (schedule_overlap) — the ratcheted latency-hiding signal — and the
+    # count of slack-0 (fully serialized) collectives behind it.
+    overlap_score: float = 0.0
+    serialized_collectives: int = 0
     # Distinct all-gather result shapes the strategy does not predict
     # (empty when no prediction applies, e.g. the serve decode path).
     unpredicted_gathers: tuple[tuple[int, ...], ...] = ()
@@ -251,12 +352,14 @@ class ProgramComms:
             "collective_count": self.collective_count,
             "collective_bytes": self.collective_bytes,
             "peak_hbm_bytes": self.peak_hbm_bytes,
+            "overlap_score": self.overlap_score,
         }
 
     def to_dict(self) -> dict:
         return {
             "name": self.name,
             **self.budget,
+            "serialized_collectives": self.serialized_collectives,
             "by_op": dict(sorted(self.by_op.items())),
             "bytes_by_op": dict(sorted(self.bytes_by_op.items())),
             "flops": self.flops,
@@ -309,6 +412,8 @@ class CommsWatcher:
             bytes_by_op=comms["bytes_by_op"],
             flops=comms["flops"],
             bytes_accessed=comms["bytes_accessed"],
+            overlap_score=comms["overlap_score"],
+            serialized_collectives=comms["serialized_collectives"],
             unpredicted_gathers=tuple(sorted(unpredicted)),
             audited_file=audited_file,
         )
@@ -369,6 +474,33 @@ def violations_for(
     budget_programs = {}
     if budget is not None and int(budget.get("device_count", -1)) == device_count:
         budget_programs = budget.get("programs", {})
+    by_name = {p.name: p for p in programs}
+    for p in programs:
+        # The overlap pair invariant needs no committed budget: a
+        # `<name>_overlap` program exists to BEAT `<name>`, so a score
+        # that fails to strictly exceed the monolithic baseline's means
+        # the bucket schedule serialized a collective it was built to
+        # hide.
+        base = by_name.get(p.name[: -len("_overlap")]) if p.name.endswith(
+            "_overlap"
+        ) else None
+        if base is not None and p.overlap_score <= base.overlap_score:
+            out.append(
+                Violation(
+                    rule=AUDIT_RULE_OVERLAP,
+                    path=p.audited_file or str(AUDITED_FILE),
+                    line=1,
+                    col=1,
+                    message=(
+                        f"serialized collective on the {p.name} path: the "
+                        "bucketed program's overlap_score does not strictly "
+                        f"exceed the monolithic {base.name} baseline's — the "
+                        "explicit bucket schedule is buying no latency "
+                        "hiding (parallel/overlap.py; comms-audit sentinel, "
+                        "see docs/STATIC_ANALYSIS.md comms runbook)"
+                    ),
+                )
+            )
     for p in programs:
         anchor = p.audited_file or str(AUDITED_FILE)
         for shape in p.unpredicted_gathers:
@@ -415,6 +547,28 @@ def violations_for(
                         "(scripts/comms_budget.json; re-measure with "
                         "scripts/comms_audit.py --write-budget if the "
                         "increase is intended — comms-audit sentinel, see "
+                        "docs/STATIC_ANALYSIS.md comms runbook)"
+                    ),
+                )
+            )
+        committed_score = committed.get("overlap_score")
+        if committed_score is not None and p.overlap_score < float(
+            committed_score
+        ):
+            out.append(
+                Violation(
+                    rule=AUDIT_RULE_OVERLAP,
+                    path=anchor,
+                    line=1,
+                    col=1,
+                    message=(
+                        f"overlap regression on the {p.name} path: the "
+                        "compiled schedule's overlap_score fell below the "
+                        "committed budget — a gradient-sync collective that "
+                        "a bucket boundary could hide is now serialized "
+                        "(scripts/comms_budget.json; re-measure with "
+                        "scripts/comms_audit.py --write-budget if the drop "
+                        "is intended — comms-audit sentinel, see "
                         "docs/STATIC_ANALYSIS.md comms runbook)"
                     ),
                 )
@@ -477,8 +631,16 @@ def run_comms_audit(
     budget_path: Path | str | None = DEFAULT_BUDGET_PATH,
     serve: bool = True,
 ) -> CommsAuditReport:
-    """Audit the real fsdp train step, multi-step scan body, and serve
-    decode step for communication and HBM pressure.
+    """Audit the real fsdp train step, multi-step scan body, serve
+    decode step, and the dp comms-overlap pair for communication and
+    HBM pressure.
+
+    The dp pair is the overlap ratchet's proof surface: the SAME model,
+    batch, and mesh lowered monolithically (``train_step_dp``) and
+    through the bucketed engine (``train_step_dp_overlap``,
+    ``multi_step_dp_overlap`` with grad accumulation pipelining sync
+    into the scan body) — DLC512 requires the bucketed schedule's
+    overlap_score to strictly exceed the monolithic baseline's.
 
     Pure lower+compile — no step executes, so the audit is fast and
     deterministic: the same source compiles to the same HLO, which is
@@ -520,6 +682,53 @@ def run_comms_audit(
         ys = np.stack([b.y for b in stack])
         compiled_multi = kfn.lower(state, xs, ys).compile()
         watcher.watch("multi_step", compiled_multi, prediction=prediction)
+
+    # The dp overlap pair: monolithic vs bucketed sync on an identical
+    # dp mesh/model/batch.  The small bucket target (32 KiB against the
+    # ~270 KiB audit param tree) forces several fused buckets so the
+    # schedule genuinely interleaves sync with compute; grad accumulation
+    # on the multi-step variant exercises the pipelined scan body.
+    dp_mesh = build_mesh(MeshSpec.data_parallel(n), devices[:n])
+    dp_kwargs = dict(learning_rate=0.05, optimizer="sgd", strategy="dp")
+    mono_dp = Trainer(_audit_model(), dp_mesh, TrainerConfig(**dp_kwargs))
+    overlap_dp = Trainer(
+        _audit_model(),
+        dp_mesh,
+        TrainerConfig(
+            comms_overlap=True, overlap_bucket_bytes=32 * 1024, **dp_kwargs
+        ),
+    )
+    overlap_accum_dp = Trainer(
+        _audit_model(),
+        dp_mesh,
+        TrainerConfig(
+            comms_overlap=True,
+            overlap_bucket_bytes=32 * 1024,
+            grad_accum_steps=2,
+            **dp_kwargs,
+        ),
+    )
+    with compat.set_mesh(dp_mesh):
+        dp_state = mono_dp.init(jax.random.PRNGKey(0), sample.x)
+        dp_prediction = StrategyPrediction.from_state(dp_state)
+        watcher.watch(
+            "train_step_dp",
+            mono_dp.step_fn.lower(dp_state, sample.x, sample.y).compile(),
+            prediction=dp_prediction,
+        )
+        ov_state = overlap_dp.init(jax.random.PRNGKey(0), sample.x)
+        watcher.watch(
+            "train_step_dp_overlap",
+            overlap_dp.step_fn.lower(ov_state, sample.x, sample.y).compile(),
+            prediction=dp_prediction,
+        )
+        acc_state = overlap_accum_dp.init(jax.random.PRNGKey(0), sample.x)
+        kfn_ov = overlap_accum_dp.multi_step_fn(k)
+        watcher.watch(
+            "multi_step_dp_overlap",
+            kfn_ov.lower(acc_state, xs, ys).compile(),
+            prediction=dp_prediction,
+        )
 
     if serve:
         watcher.programs.append(_audit_serve_decode())

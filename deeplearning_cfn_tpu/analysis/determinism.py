@@ -8,7 +8,8 @@ the acceptance criterion for the federated sim and the composed
 gauntlet).  These rules encode the hazards that silently break the
 contract, scoped to the determinism-bearing packages (chaos/, sched/,
 cluster/, obs/, train/datastream/, serve/loadgen.py,
-analysis/schedules.py):
+analysis/schedules.py, parallel/overlap.py — the bucket planner's
+output order is an SPMD contract, so it is held to the same bar):
 
 DLC600 unsorted-fs-enumeration  os.listdir/glob/Path.iterdir results
                                 feeding iteration, a subscript, or a
@@ -86,6 +87,10 @@ def _applies_determinism_paths(path: Path) -> bool:
     if path.name == "loadgen.py" and "serve" in parts:
         return True
     if path.name == "schedules.py" and "analysis" in parts:
+        return True
+    # The bucket planner must emit the same bucket order on every host or
+    # the fused collectives deadlock — replay-critical like the rest.
+    if path.name == "overlap.py" and "parallel" in parts:
         return True
     return False
 

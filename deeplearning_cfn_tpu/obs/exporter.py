@@ -58,6 +58,7 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
     "dlcfn_comms_collective_bytes": ("gauge", "Bytes moved by collectives per execution of the audited program."),
     "dlcfn_comms_peak_hbm_bytes": ("gauge", "Peak-HBM estimate (args + outputs + temps - aliased) of the audited program."),
     "dlcfn_comms_collective_count": ("gauge", "Collective ops (all-gather/all-reduce/...) in the audited program's HLO."),
+    "dlcfn_comms_overlap_score": ("gauge", "Mean compute slack per collective in the audited program's optimized schedule (DLC512 ratchet)."),
     "dlcfn_replay_cases": ("gauge", "Cases (chaos scenarios + fleet soaks) double-run by the last replay audit."),
     "dlcfn_replay_divergent": ("gauge", "Cases whose same-seed double runs produced different report bytes."),
     "dlcfn_replay_clean": ("gauge", "1 when the last replay audit was byte-identical everywhere, else 0."),
@@ -223,6 +224,7 @@ def fold_comms_events(events) -> dict[str, Any]:
                     "collective_count",
                     "collective_bytes",
                     "peak_hbm_bytes",
+                    "overlap_score",
                     "by_op",
                     "unpredicted_gathers",
                 )
@@ -595,7 +597,12 @@ def render_prometheus(
                 f" {snap.get('admitted', 0)}"
             )
     if comms:
-        for key in ("collective_bytes", "peak_hbm_bytes", "collective_count"):
+        for key in (
+            "collective_bytes",
+            "peak_hbm_bytes",
+            "collective_count",
+            "overlap_score",
+        ):
             head(f"dlcfn_comms_{key}")
             for program, snap in comms.items():
                 value = snap.get(key)

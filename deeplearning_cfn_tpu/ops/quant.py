@@ -37,6 +37,26 @@ def dequantize_weight(wq: jax.Array, scale: jax.Array, dtype=jnp.float32) -> jax
     return (wq.astype(jnp.float32) * scale.astype(jnp.float32)).astype(dtype)
 
 
+def quantize_flat(v: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """``v [N] float`` -> ``(q int8 [N], scale scalar f32)``.
+
+    One symmetric scale over the whole flat vector — the shape the
+    comms-overlap engine's fused gradient buckets use
+    (parallel/overlap.py): a bucket is already a concatenation of
+    unrelated leaves, so per-channel structure is gone and a single
+    scale keeps the wire payload to ``N`` int8 bytes plus one float.
+    Zero-range input gets scale 1 (all values exactly 0 round-trip)."""
+    v32 = v.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(v32))
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(v32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_flat(q: jax.Array, scale: jax.Array, dtype=jnp.float32) -> jax.Array:
+    return (q.astype(jnp.float32) * scale.astype(jnp.float32)).astype(dtype)
+
+
 def _is_quantizable(path, leaf) -> bool:
     """Quantize kernels only: rank >= 2 leaves whose name says 'kernel'.
     Biases, norm scales/offsets, and BatchNorm stats stay float — they

@@ -40,6 +40,13 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning_cfn_tpu.parallel.overlap import (
+    ErrorFeedbackState,
+    build_overlap_grad_fn,
+    error_feedback_shardings,
+    init_error_feedback,
+    plan_buckets,
+)
 from deeplearning_cfn_tpu.parallel.sharding import (
     infer_param_sharding,
     replicated,
@@ -125,6 +132,29 @@ class TrainerConfig:
     # fixed-denominator losses (LM next-token, classification).  See
     # docs/BENCH_NOTES.md ("grad-accum and count-normalized losses").
     grad_accum_steps: int = 1
+    # The comms-overlap engine (parallel/overlap.py): replace GSPMD's
+    # end-of-backward monolithic gradient sync with deterministic,
+    # path-sorted, size-targeted buckets lowered as explicit collectives
+    # inside shard_map — with grad accumulation, microbatch k+1's
+    # backward pass overlaps bucket k's collective.  dp (replicated-
+    # param) training is bit-identical to the monolithic path; fsdp is
+    # numerically equivalent but not bitwise (GSPMD picks a different
+    # backward factorization there).  Requires stateless models (no
+    # BatchNorm collections) and a batch sharded on dim 0 over the data
+    # axes only.  The audit ratchets the resulting schedule's
+    # overlap_score (DLC512) — docs/PERFORMANCE.md, "Hiding the
+    # collectives".
+    comms_overlap: bool = False
+    # Fused-bucket byte target for the overlap planner; smaller buckets
+    # issue earlier (more overlap), larger ones amortize per-collective
+    # latency better.
+    overlap_bucket_bytes: int = 4 * 1024 * 1024
+    # int8 gradient compression over the fused (replicated) buckets:
+    # per-bucket symmetric quantization with an error-feedback residual
+    # carried in the optimizer state (~4x wire-byte cut on the dp
+    # all-reduce).  Changes numerics — convergence-gated in tests, off
+    # by default.
+    overlap_compress: bool = False
 
 
 def decay_mask(params: Any) -> Any:
@@ -399,6 +429,26 @@ class Trainer:
                 lambda _: replicated(self.mesh), abstract_params
             )
         opt_sh = self._opt_state_shardings(abstract_params, param_sh)
+        # Compressed overlap carries per-bucket error-feedback residuals
+        # in the opt state (parallel/overlap.ErrorFeedbackState), so the
+        # state tree — and its shardings — grow a wrapper here.
+        overlap_plan = None
+        if self.config.comms_overlap and self.config.overlap_compress:
+            sync_axes = self._overlap_sync_axes()
+            nd = 1
+            for a in sync_axes:
+                nd *= self.mesh.shape[a]
+            overlap_plan = plan_buckets(
+                abstract_params,
+                jax.tree_util.tree_map(lambda s: s.spec, param_sh),
+                self.config.overlap_bucket_bytes,
+            )
+            opt_sh = ErrorFeedbackState(
+                residual=error_feedback_shardings(
+                    overlap_plan, self.mesh, sync_axes
+                ),
+                inner=opt_sh,
+            )
         model_state_sh = jax.tree_util.tree_map(
             lambda _: replicated(self.mesh), abstract_model_state
         )
@@ -414,10 +464,13 @@ class Trainer:
             variables = self.model.init(rng, _prep(sample), **init_kwargs)
             params = variables["params"]
             model_state = {k: v for k, v in variables.items() if k != "params"}
+            opt_state = self.tx.init(params)
+            if overlap_plan is not None:
+                opt_state = init_error_feedback(overlap_plan, nd, opt_state)
             return TrainState(
                 step=jnp.zeros((), jnp.int32),
                 params=params,
-                opt_state=self.tx.init(params),
+                opt_state=opt_state,
                 model_state=model_state,
             )
 
@@ -451,6 +504,19 @@ class Trainer:
             transform_non_params=lambda _leaf: rep,
         )
 
+    def _overlap_sync_axes(self) -> tuple[str, ...]:
+        """The mesh axes the comms-overlap engine syncs gradients over —
+        the axes the batch's leading dim is sharded on (full validation
+        happens in parallel/overlap._resolve_sync_axes)."""
+        spec = self.batch_sharding.spec
+        dim0 = spec[0] if spec else None
+        if dim0 is None:
+            raise ValueError(
+                "comms_overlap needs the batch sharded on dim 0; got "
+                f"batch spec {spec}"
+            )
+        return (dim0,) if isinstance(dim0, str) else tuple(dim0)
+
     def rebind_mesh(self, mesh: Mesh, state_shardings: TrainState) -> None:
         """Point the trainer at a new mesh with a matching sharding
         template — the live-reshard seam (train/reshard.py).  The batch
@@ -466,6 +532,38 @@ class Trainer:
         self._eval_fn = None
 
     # --- the step -------------------------------------------------------
+    def _overlap_grads(self, loss_fn, state: TrainState, x, y, accum: int):
+        """Trace-time dispatch into the comms-overlap engine: plan the
+        buckets from the (traced) parameter tree's shapes and lower the
+        loss/grad/sync step through parallel/overlap.py.  Runs inside
+        the jitted step, so the plan and the shard_map are rebuilt once
+        per compile — never per step."""
+        if state.model_state:
+            raise ValueError(
+                "comms_overlap requires stateless models (no mutable "
+                "collections such as BatchNorm stats); got model_state "
+                f"keys {sorted(state.model_state)}"
+            )
+        assert self.state_shardings is not None, "call init() before the step"
+        param_specs = jax.tree_util.tree_map(
+            lambda s: s.spec, self.state_shardings.params
+        )
+        plan = plan_buckets(
+            state.params, param_specs, self.config.overlap_bucket_bytes
+        )
+        compress = self.config.overlap_compress
+        fn = build_overlap_grad_fn(
+            loss_fn,
+            self.mesh,
+            param_specs,
+            self.batch_sharding.spec,
+            plan,
+            accum=accum,
+            compress=compress,
+        )
+        residuals = state.opt_state.residual if compress else ()
+        return fn(state.params, x, y, residuals)
+
     def _raw_step_fn(self):
         """The unjitted single-step body, shared by the jitted step and
         the multi-step scan so their semantics cannot drift."""
@@ -480,6 +578,8 @@ class Trainer:
             raise ValueError(f"grad_accum_steps must be >= 1, got {accum}")
 
         augment = self.config.augment
+        overlap = self.config.comms_overlap
+        compress = self.config.overlap_compress
 
         def step_fn(state: TrainState, x: jax.Array, y: jax.Array):
             ctx = (
@@ -498,7 +598,12 @@ class Trainer:
                 if augment is not None:
                     x = augment(state.step, x)
                 x = self._normalize_input(x)
-                if accum == 1:
+                if overlap:
+                    loss, aux, grads, new_residuals = self._overlap_grads(
+                        loss_fn, state, x, y, accum
+                    )
+                    new_model_state = state.model_state
+                elif accum == 1:
                     (loss, (aux, new_model_state)), grads = jax.value_and_grad(
                         loss_fn, has_aux=True
                     )(state.params, state.model_state, x, y)
@@ -507,7 +612,17 @@ class Trainer:
                         loss_fn, state, x, y, accum
                     )
             metrics = {"loss": loss, **aux}
-            updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
+            if overlap and compress:
+                updates, new_inner = self.tx.update(
+                    grads, state.opt_state.inner, state.params
+                )
+                new_opt = ErrorFeedbackState(
+                    residual=new_residuals, inner=new_inner
+                )
+            else:
+                updates, new_opt = self.tx.update(
+                    grads, state.opt_state, state.params
+                )
             new_params = optax.apply_updates(state.params, updates)
             new_state = TrainState(
                 step=state.step + 1,

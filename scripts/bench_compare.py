@@ -5,7 +5,12 @@ across re-anchors) was only visible at re-anchor time because nothing
 diffed consecutive bench rounds.  This prints a one-line verdict per
 tracked metric — MFU, images/sec/chip, and (when a round records them)
 collective bytes and compile/retrace counts — plus an overall line
-check.sh surfaces on every PR.  Rounds fed by different input paths
+check.sh surfaces on every PR.  Rounds that record a per-program
+``comms`` block (bench.py) additionally get per-program collective
+bytes/step and overlap_score deltas, and a newest round that ran
+``mode: single_step`` is flagged "campaign unproven" — the scanned
+overlap path was never dispatched, so its numbers prove nothing about
+latency hiding.  Rounds fed by different input paths
 (``input_mode``: synthetic vs records) are flagged NOT COMPARABLE
 instead of diffed — the records path does strictly more work per step.
 
@@ -37,6 +42,57 @@ TRACKED: tuple[tuple[str, str, bool], ...] = (
 
 #: relative change below this is noise, not a verdict
 EPSILON = 0.005
+
+
+#: per-program comms-block keys worth trending (bench.py comms_block),
+#: with the direction that counts as an improvement.  overlap_score is
+#: the DLC512-ratcheted schedule-slack number; bytes are per step so
+#: single- and multi-step rounds compare directly.
+COMMS_TRACKED: tuple[tuple[str, str, bool], ...] = (
+    ("collective_bytes_per_step", "collective bytes/step", False),
+    ("overlap_score", "overlap_score", True),
+)
+
+
+def comms_diff(old: dict, new: dict) -> tuple[list[str], list[str]]:
+    """(regressed_labels, lines) diffing the per-program ``comms`` block
+    between two rounds.  Programs are matched by name; a program or the
+    whole block missing from one side is reported, never a crash (older
+    emitters predate the block)."""
+    a, b = old.get("comms"), new.get("comms")
+    if not isinstance(a, dict) and not isinstance(b, dict):
+        return [], []
+    if not isinstance(a, dict) or not isinstance(b, dict):
+        which = "the old round" if not isinstance(a, dict) else "the new round"
+        return [], [f"  comms: not recorded in {which}"]
+    regressed, lines = [], []
+    for name in sorted(set(a) | set(b)):
+        pa, pb = a.get(name), b.get(name)
+        if not isinstance(pa, dict) or not isinstance(pb, dict):
+            which = "the old round" if not isinstance(pa, dict) else "the new round"
+            lines.append(f"  comms[{name}]: not recorded in {which}")
+            continue
+        for key, label, higher in COMMS_TRACKED:
+            verdict, line = diff_line(
+                key, label, higher, {key: pa.get(key)}, {key: pb.get(key)}
+            )
+            lines.append(f"  comms[{name}] {line.strip()}")
+            if verdict == "regressed":
+                regressed.append(f"comms[{name}].{label}")
+    return regressed, lines
+
+
+def campaign_unproven(new: dict) -> str | None:
+    """A newest round that ran ``mode: single_step`` never exercised the
+    scanned multi-step dispatch path the comms-overlap campaign targets,
+    so its numbers prove nothing about latency hiding — flag it rather
+    than letting a flat diff read as 'overlap still fine'."""
+    if new.get("mode") == "single_step":
+        return (
+            "campaign unproven: newest round ran mode=single_step, the "
+            "comms-overlap path was never dispatched"
+        )
+    return None
 
 
 def mode_regression(old: dict, new: dict) -> str | None:
@@ -123,6 +179,8 @@ def main(argv: list[str] | None = None) -> int:
         lines.append(line)
         if verdict in ("improved", "regressed", "flat"):
             verdicts.append((label, verdict))
+    comms_regressed, comms_lines = comms_diff(old, new)
+    lines.extend(comms_lines)
     if isinstance(old.get("mode"), str) or isinstance(new.get("mode"), str):
         lines.append(f"  mode: {old.get('mode')} -> {new.get('mode')}")
     if isinstance(old.get("input_mode"), str) or isinstance(
@@ -132,9 +190,11 @@ def main(argv: list[str] | None = None) -> int:
             f"  input mode: {old.get('input_mode')} -> {new.get('input_mode')}"
         )
     regressed = [label for label, v in verdicts if v == "regressed"]
+    regressed += comms_regressed
     improved = [label for label, v in verdicts if v == "improved"]
     mode_note = mode_regression(old, new)
     input_note = input_mode_mismatch(old, new)
+    unproven_note = campaign_unproven(new)
     if input_note:
         # Different input paths: the numeric verdicts below are apples
         # to oranges — say so instead of calling either direction a
@@ -151,6 +211,10 @@ def main(argv: list[str] | None = None) -> int:
         headline = f"improved ({', '.join(improved)})"
     else:
         headline = "flat"
+    if unproven_note:
+        # Not a numeric verdict: the round's dispatch mode means the
+        # overlap campaign's claim simply went untested this round.
+        headline = f"{headline}; {unproven_note}"
     print(
         f"bench-compare: {old_path.name} -> {new_path.name}: {headline} [warn-only]"
     )

@@ -100,11 +100,13 @@ JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   > /tmp/_compile_audit.json || { cat /tmp/_compile_audit.json; exit 1; }
 echo "compile-audit: steady-state zero retrace, donation effective (report: /tmp/_compile_audit.json)"
 
-echo "== comms-audit sentinel (HLO collective + HBM budget ratchet) =="
-# Lowers the real fsdp train step, multi-step scan body, and serve
-# decode on 8 virtual devices and reads the HLO: collective bytes/count
-# over the committed budget (DLC510) or an all-gather fsdp doesn't
-# predict (DLC511) fails here unless baselined
+echo "== comms-audit sentinel (HLO collective + HBM budget + overlap ratchet) =="
+# Lowers the real fsdp train step, multi-step scan body, serve decode,
+# and the dp comms-overlap pair on 8 virtual devices and reads the HLO:
+# collective bytes/count over the committed budget (DLC510), an
+# all-gather fsdp doesn't predict (DLC511), or a schedule overlap_score
+# below the committed number / a *_overlap program not strictly beating
+# its monolithic baseline (DLC512) fails here unless baselined
 # (docs/STATIC_ANALYSIS.md comms runbook).
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python scripts/comms_audit.py --baseline scripts/lint_baseline.json \

@@ -1,16 +1,22 @@
 """Comms-audit CI stage: communication and HBM budgets, proven from HLO.
 
-Lowers and compiles the real fsdp train step, multi-step scan body, and
-serve decode step on 8 virtual CPU devices under a
-:class:`analysis.comms_audit.CommsWatcher`, machine-reads each
-executable's HLO for collectives plus cost/memory analysis, and applies
-the same suppression-baseline ratchet as ``dlcfn lint``
-(scripts/lint_baseline.json, DLC51x namespace only):
+Lowers and compiles the real fsdp train step, multi-step scan body,
+serve decode step, and the dp comms-overlap pair (monolithic
+``train_step_dp`` vs bucketed ``train_step_dp_overlap`` /
+``multi_step_dp_overlap`` — parallel/overlap.py) on 8 virtual CPU
+devices under a :class:`analysis.comms_audit.CommsWatcher`,
+machine-reads each executable's HLO for collectives, schedule slack,
+and cost/memory analysis, and applies the same suppression-baseline
+ratchet as ``dlcfn lint`` (scripts/lint_baseline.json, DLC51x
+namespace only):
 
 - a program whose collective op count or bytes regress over the
   committed budget (scripts/comms_budget.json) -> DLC510 -> exit 1
 - an fsdp step containing an all-gather the strategy doesn't predict
   -> DLC511 -> exit 1 (unless baselined)
+- a program whose schedule overlap_score falls below the committed
+  number, or a ``*_overlap`` program that fails to strictly beat its
+  monolithic baseline -> DLC512 -> exit 1 (unless baselined)
 - a baseline entry whose DLC51x finding no longer fires -> stale nag
 
 ``--write-budget`` re-measures and rewrites scripts/comms_budget.json —

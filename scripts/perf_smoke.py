@@ -24,7 +24,11 @@ step path keeps enqueuing), a fleet-scheduler stage (placement is a
 deterministic pure function under permuted submission, quota invariants
 hold, and the sched package never reads the wall clock), and an
 exact-match check of the audited train step's collective bytes against
-the committed comms budget (8-virtual-device runs only) ride along.
+the committed comms budget (8-virtual-device runs only) ride along,
+plus a comms-overlap stage (the bucketed gradient-sync program's
+audited overlap_score strictly beats the monolithic baseline's, bucket
+byte accounting sums exactly to the grad tree, and the overlap step
+holds zero steady-state retraces).
 
 Exit 0 and one JSON line on success; exit 1 with a message on violation.
 """
@@ -264,6 +268,117 @@ def comms_budget() -> tuple[dict, list[str]]:
     return {
         "train_step": measured.budget,
         "committed": committed,
+    }, failures
+
+
+OVERLAP_BUCKET_BYTES = 32 * 1024
+
+
+def comms_overlap() -> tuple[dict, list[str]]:
+    """Comms-overlap stage: the bucketed gradient-sync engine
+    (parallel/overlap.py) must actually buy what it promises, proven
+    structurally on the 8-device virtual mesh:
+
+    (1) the bucketed dp program's audited ``overlap_score`` is STRICTLY
+        greater than the monolithic program's on the same model, mesh,
+        and batch — the schedule genuinely interleaves sync with
+        compute (the DLC512 pair invariant, checked here without the
+        committed budget in the loop);
+    (2) the bucket plan's byte accounting sums exactly to the gradient
+        tree — every leaf lands in exactly one bucket, nothing double-
+        synced or dropped;
+    (3) the overlap step compiles once and never again across
+        steady-state steps (zero retraces under ``CompileWatcher`` —
+        the trace-time bucket planning must be compile-stable)."""
+    from deeplearning_cfn_tpu.analysis.comms_audit import (
+        AUDIT_BATCH_SIZE,
+        AUDIT_CLASSES,
+        AUDIT_INPUT_SHAPE,
+        _audit_model,
+        program_comms,
+    )
+    from deeplearning_cfn_tpu.analysis.compile_audit import CompileWatcher
+    from deeplearning_cfn_tpu.parallel.mesh import MeshSpec, build_mesh
+    from deeplearning_cfn_tpu.parallel.overlap import plan_buckets
+    from deeplearning_cfn_tpu.train.data import SyntheticDataset
+    from deeplearning_cfn_tpu.train.trainer import Trainer, TrainerConfig
+    from deeplearning_cfn_tpu.utils import compat
+
+    failures: list[str] = []
+    if jax.device_count() < 8:
+        return {
+            "skipped": f"needs 8 virtual devices, have {jax.device_count()}"
+        }, failures
+    mesh = build_mesh(MeshSpec.data_parallel(8), jax.devices()[:8])
+    ds = SyntheticDataset(
+        shape=AUDIT_INPUT_SHAPE,
+        num_classes=AUDIT_CLASSES,
+        batch_size=AUDIT_BATCH_SIZE,
+        seed=0,
+    )
+    sample = next(iter(ds.batches(1)))
+    kwargs = dict(learning_rate=0.05, optimizer="sgd", strategy="dp")
+    mono = Trainer(_audit_model(), mesh, TrainerConfig(**kwargs))
+    bucketed = Trainer(
+        _audit_model(),
+        mesh,
+        TrainerConfig(
+            comms_overlap=True,
+            overlap_bucket_bytes=OVERLAP_BUCKET_BYTES,
+            **kwargs,
+        ),
+    )
+    with compat.set_mesh(mesh):
+        mono_state = mono.init(jax.random.PRNGKey(0), sample.x)
+        mono_score = program_comms(
+            mono.step_fn.lower(mono_state, sample.x, sample.y).compile()
+        )["overlap_score"]
+        with CompileWatcher() as watcher:
+            state = bucketed.init(jax.random.PRNGKey(0), sample.x)
+            bucket_score = program_comms(
+                bucketed.step_fn.lower(state, sample.x, sample.y).compile()
+            )["overlap_score"]
+            state, metrics = bucketed.train_step(state, sample.x, sample.y)
+            jax.block_until_ready(metrics["loss"])
+            watcher.mark_steady()
+            for _ in range(3):
+                state, metrics = bucketed.train_step(
+                    state, sample.x, sample.y
+                )
+            jax.block_until_ready(metrics["loss"])
+            retraces = watcher.new_compiles_since_mark()
+    if bucket_score <= mono_score:
+        failures.append(
+            f"bucketed overlap_score {bucket_score} does not strictly "
+            f"exceed the monolithic baseline's {mono_score} — the "
+            "bucket schedule is buying no latency hiding"
+        )
+    specs = jax.tree_util.tree_map(
+        lambda s: s.spec, bucketed.state_shardings.params
+    )
+    plan = plan_buckets(state.params, specs, OVERLAP_BUCKET_BYTES)
+    leaves = jax.tree_util.tree_leaves(state.params)
+    tree_bytes = sum(l.size * l.dtype.itemsize for l in leaves)
+    if plan.total_bytes != tree_bytes:
+        failures.append(
+            f"bucket byte accounting {plan.total_bytes} != grad tree "
+            f"{tree_bytes} — a leaf was dropped or double-bucketed"
+        )
+    bucketed_leaves = sum(len(b.indices) for b in plan.buckets)
+    if bucketed_leaves != len(leaves):
+        failures.append(
+            f"bucket plan covers {bucketed_leaves} leaves of {len(leaves)}"
+        )
+    if retraces:
+        failures.append(
+            f"overlap step recompiled after warmup: {sorted(retraces)}"
+        )
+    return {
+        "monolithic_overlap_score": mono_score,
+        "bucketed_overlap_score": bucket_score,
+        "buckets": len(plan.buckets),
+        "bucket_bytes": plan.total_bytes,
+        "post_warmup_compiles": len(retraces),
     }, failures
 
 
@@ -996,6 +1111,9 @@ def main() -> int:
     comms_snap, comms_failures = comms_budget()
     failures.extend(comms_failures)
 
+    comms_overlap_snap, comms_overlap_failures = comms_overlap()
+    failures.extend(comms_overlap_failures)
+
     det_snap, det_failures = determinism()
     failures.extend(det_failures)
 
@@ -1025,6 +1143,7 @@ def main() -> int:
                 "datastream": datastream_snap,
                 "sched": sched_snap,
                 "comms": comms_snap,
+                "comms_overlap": comms_overlap_snap,
                 "determinism": det_snap,
             },
             allow_nan=False,

@@ -166,3 +166,121 @@ def test_input_mode_mismatch_helper_direct():
     assert f({}, {"input_mode": "records"}) is None
     assert f({"input_mode": "synthetic"}, {}) is None
     assert f({"input_mode": 3}, {"input_mode": "records"}) is None
+
+
+# --- the comms-block diff (PR 20 comms-overlap campaign) ---------------------
+
+
+def _comms(nbytes: int, score: float) -> dict:
+    return {
+        "collective_count": 8,
+        "collective_bytes_per_step": nbytes,
+        "peak_hbm_bytes": 1000,
+        "overlap_score": score,
+    }
+
+
+def test_comms_regression_named_per_program(tmp_path, capsys):
+    """Per-program deltas: bytes growing or overlap_score shrinking on
+    any audited program is a named regression in the headline."""
+    _write_round(
+        tmp_path, 1,
+        {"mfu": 0.41, "comms": {"train_step": _comms(11544, 7.1)}},
+    )
+    _write_round(
+        tmp_path, 2,
+        {"mfu": 0.41, "comms": {"train_step": _comms(12000, 5.0)}},
+    )
+    rc = bench_compare.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0  # warn-only, even on a comms regression
+    headline = out.splitlines()[0]
+    assert "REGRESSED" in headline
+    assert "comms[train_step].collective bytes/step" in headline
+    assert "comms[train_step].overlap_score" in headline
+    assert "comms[train_step] collective bytes/step: 11544 -> 12000" in out
+    assert "comms[train_step] overlap_score: 7.1 -> 5.0" in out
+
+
+def test_comms_improvement_and_flat_do_not_regress(tmp_path, capsys):
+    _write_round(
+        tmp_path, 1,
+        {"mfu": 0.41, "comms": {"train_step": _comms(11544, 3.0)}},
+    )
+    _write_round(
+        tmp_path, 2,
+        {"mfu": 0.41, "comms": {"train_step": _comms(11544, 3.75)}},
+    )
+    rc = bench_compare.main([str(tmp_path)])
+    headline = capsys.readouterr().out.splitlines()[0]
+    assert rc == 0
+    assert "REGRESSED" not in headline
+
+
+def test_comms_block_missing_from_a_round_is_reported_not_diffed(
+    tmp_path, capsys
+):
+    _write_round(tmp_path, 1, {"mfu": 0.41})
+    _write_round(
+        tmp_path, 2, {"mfu": 0.41, "comms": {"train_step": _comms(1, 1.0)}}
+    )
+    rc = bench_compare.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "comms: not recorded in the old round" in out
+    assert "REGRESSED" not in out.splitlines()[0]
+
+
+def test_comms_program_only_in_one_round_is_reported(tmp_path, capsys):
+    _write_round(
+        tmp_path, 1, {"mfu": 0.41, "comms": {"train_step": _comms(1, 1.0)}}
+    )
+    _write_round(
+        tmp_path, 2,
+        {
+            "mfu": 0.41,
+            "comms": {
+                "train_step": _comms(1, 1.0),
+                "multi_step_k2": _comms(2, 2.0),
+            },
+        },
+    )
+    rc = bench_compare.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "comms[multi_step_k2]: not recorded in the old round" in out
+
+
+# --- the campaign-unproven flag ----------------------------------------------
+
+
+def test_single_step_newest_round_is_flagged_campaign_unproven(
+    tmp_path, capsys
+):
+    """A newest round that never dispatched the scanned multi-step path
+    proves nothing about the overlap campaign — the headline must say
+    so even when every metric is flat."""
+    metrics = {"mfu": 0.41}
+    _write_round(tmp_path, 1, dict(metrics))
+    _write_round(tmp_path, 2, {**metrics, "mode": "single_step"})
+    rc = bench_compare.main([str(tmp_path)])
+    headline = capsys.readouterr().out.splitlines()[0]
+    assert rc == 0
+    assert "campaign unproven" in headline
+    assert "single_step" in headline
+
+
+def test_multi_step_newest_round_is_not_flagged(tmp_path, capsys):
+    _write_round(tmp_path, 1, {"mfu": 0.41, "mode": "multi_step_k2"})
+    _write_round(tmp_path, 2, {"mfu": 0.41, "mode": "multi_step_k2"})
+    rc = bench_compare.main([str(tmp_path)])
+    headline = capsys.readouterr().out.splitlines()[0]
+    assert rc == 0
+    assert "campaign unproven" not in headline
+
+
+def test_campaign_unproven_helper_direct():
+    f = bench_compare.campaign_unproven
+    assert f({"mode": "single_step"}) is not None
+    assert f({"mode": "multi_step_k2"}) is None
+    assert f({}) is None

@@ -69,9 +69,9 @@ def test_noqa_suppresses_with_reason():
 
 
 def test_audit_rule_ids_are_reserved_not_static():
-    """DLC510/511 belong to the dynamic sentinel: no static rule may
+    """DLC510/511/512 belong to the dynamic sentinel: no static rule may
     claim them, so the baseline namespaces stay disjoint."""
-    assert set(AUDIT_RULE_IDS) == {"DLC510", "DLC511"}
+    assert set(AUDIT_RULE_IDS) == {"DLC510", "DLC511", "DLC512"}
     assert not set(AUDIT_RULE_IDS) & set(RULE_IDS)
 
 

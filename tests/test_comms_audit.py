@@ -9,6 +9,13 @@ variant must come back parameter-gathers-only), and ``run_comms_audit``
 driving the real Trainer: every program yields a non-empty budget that
 matches scripts/comms_budget.json exactly, and every finding on the
 repo's own hot path is already captured in the ratcheted baseline.
+
+Plus the DLC512 overlap instrument: ``schedule_overlap`` must read
+compute slack per collective issue point out of scheduled HLO text
+(async ``-start``/``-done`` pairs included), and ``violations_for``
+must fire when a ``*_overlap`` program fails to strictly beat its
+monolithic baseline or when a program's score falls below the
+committed budget.
 """
 
 import jax
@@ -20,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning_cfn_tpu.analysis.collectives import (
     AUDIT_RULE_BUDGET,
     AUDIT_RULE_IDS,
+    AUDIT_RULE_OVERLAP,
     AUDIT_RULE_UNPREDICTED,
 )
 from deeplearning_cfn_tpu.analysis.comms_audit import (
@@ -28,11 +36,24 @@ from deeplearning_cfn_tpu.analysis.comms_audit import (
     ProgramComms,
     StrategyPrediction,
     hlo_collectives,
+    hlo_computation_ops,
     load_budget,
     run_comms_audit,
+    schedule_overlap,
     violations_for,
     write_budget,
 )
+
+#: every program the real audit lowers (the fsdp trio plus the dp
+#: comms-overlap pair and its scanned multi-step variant)
+AUDITED_PROGRAMS = {
+    "train_step",
+    "multi_step",
+    "train_step_dp",
+    "train_step_dp_overlap",
+    "multi_step_dp_overlap",
+    "serve_decode",
+}
 
 # --- the HLO readout ---------------------------------------------------------
 
@@ -59,6 +80,86 @@ def test_hlo_collectives_reads_sync_and_async_ops():
 def test_hlo_collectives_ignores_non_collective_ops():
     hlo = "  %d = f32[16,64]{1,0} dot(f32[16,8]{1,0} %a, f32[8,64]{1,0} %b)\n"
     assert hlo_collectives(hlo) == []
+
+
+# --- the schedule-overlap readout --------------------------------------------
+
+_SCHEDULED_HLO = """\
+ENTRY %main (p0: f32[16,64]) -> f32[16,64] {
+  %p0 = f32[16,64]{1,0} parameter(0)
+  %ar = f32[16,64]{1,0} all-reduce(f32[16,64]{1,0} %p0), to_apply=%sum
+  %m1 = f32[16,64]{1,0} multiply(f32[16,64]{1,0} %ar, f32[16,64]{1,0} %p0)
+  %m2 = f32[16,64]{1,0} add(f32[16,64]{1,0} %m1, f32[16,64]{1,0} %p0)
+  ROOT %ag = f32[16,64]{1,0} all-gather(f32[16,64]{1,0} %m2), replica_groups={}
+}
+"""
+
+
+def test_schedule_overlap_counts_slack_between_issue_points():
+    """First all-reduce has 2 ops of slack before the next collective;
+    the final all-gather ends the computation with 0 — serialized."""
+    overlap = schedule_overlap(_SCHEDULED_HLO)
+    assert overlap == {
+        "overlap_score": 1.0,
+        "serialized_collectives": 1,
+        "scheduled_collectives": 2,
+    }
+
+
+def test_schedule_overlap_async_done_is_a_boundary_not_an_issue_point():
+    """The ops between -start and -done ARE the start's slack; the -done
+    half must not count as a second collective issue."""
+    hlo = """\
+ENTRY %main (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  %ars = (f32[8]{0}, u32[]) all-reduce-start(f32[8]{0} %p0), to_apply=%sum
+  %m1 = f32[8]{0} multiply(f32[8]{0} %p0, f32[8]{0} %p0)
+  %m2 = f32[8]{0} add(f32[8]{0} %m1, f32[8]{0} %p0)
+  %m3 = f32[8]{0} subtract(f32[8]{0} %m2, f32[8]{0} %p0)
+  ROOT %ard = f32[8]{0} all-reduce-done((f32[8]{0}, u32[]) %ars)
+}
+"""
+    overlap = schedule_overlap(hlo)
+    assert overlap == {
+        "overlap_score": 3.0,
+        "serialized_collectives": 0,
+        "scheduled_collectives": 1,
+    }
+
+
+def test_schedule_overlap_zero_for_collective_free_programs():
+    hlo = """\
+ENTRY %main (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  ROOT %m = f32[8]{0} multiply(f32[8]{0} %p0, f32[8]{0} %p0)
+}
+"""
+    assert schedule_overlap(hlo)["overlap_score"] == 0.0
+    assert schedule_overlap("")["overlap_score"] == 0.0
+
+
+def test_hlo_computation_ops_splits_per_computation_in_order():
+    """Headers at column zero open a computation; a bare ``}`` closes
+    it; instruction order within each body is preserved (HLO prints the
+    schedule)."""
+    hlo = """\
+%sum (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %add = f32[] add(f32[] %a, f32[] %b)
+}
+
+ENTRY %main (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  %ar = f32[8]{0} all-reduce(f32[8]{0} %p0), to_apply=%sum
+  ROOT %m = f32[8]{0} multiply(f32[8]{0} %ar, f32[8]{0} %p0)
+}
+"""
+    comps = hlo_computation_ops(hlo)
+    assert list(comps.values()) == [
+        ["parameter", "parameter", "add"],
+        ["parameter", "all-reduce", "multiply"],
+    ]
 
 
 def test_strategy_prediction_covers_exactly_the_state_leaves():
@@ -132,7 +233,7 @@ def test_constrained_variant_gathers_only_what_fsdp_predicts(golden):
 # --- the DLC510 budget ratchet -----------------------------------------------
 
 
-def _program(name="train_step", count=8, nbytes=11544, peak=1000):
+def _program(name="train_step", count=8, nbytes=11544, peak=1000, overlap=0.0):
     return ProgramComms(
         name=name,
         collective_count=count,
@@ -142,10 +243,12 @@ def _program(name="train_step", count=8, nbytes=11544, peak=1000):
         bytes_by_op={},
         flops=None,
         bytes_accessed=None,
+        overlap_score=overlap,
     )
 
 
-def _budget(count=8, nbytes=11544, device_count=8, name="train_step"):
+def _budget(count=8, nbytes=11544, device_count=8, name="train_step",
+            overlap=0.0):
     return {
         "device_count": device_count,
         "programs": {
@@ -153,6 +256,7 @@ def _budget(count=8, nbytes=11544, device_count=8, name="train_step"):
                 "collective_count": count,
                 "collective_bytes": nbytes,
                 "peak_hbm_bytes": 1000,
+                "overlap_score": overlap,
             }
         },
     }
@@ -193,6 +297,68 @@ def test_dlc510_skips_programs_the_budget_never_committed():
     assert violations == []
 
 
+# --- the DLC512 overlap ratchet ----------------------------------------------
+
+
+def test_dlc512_fires_when_the_overlap_program_fails_to_beat_its_base():
+    """A `<name>_overlap` program exists to BEAT `<name>`; a tie means
+    the bucket schedule bought nothing.  Needs no committed budget."""
+    pair = [
+        _program(name="train_step_dp", overlap=3.0),
+        _program(name="train_step_dp_overlap", overlap=3.0),
+    ]
+    violations = violations_for(pair, budget=None, device_count=8)
+    assert [v.rule for v in violations] == [AUDIT_RULE_OVERLAP]
+    assert "strictly exceed" in violations[0].message
+    assert "train_step_dp_overlap" in violations[0].message
+
+
+def test_dlc512_quiet_when_the_overlap_program_strictly_wins():
+    pair = [
+        _program(name="train_step_dp", overlap=3.0),
+        _program(name="train_step_dp_overlap", overlap=3.75),
+    ]
+    assert violations_for(pair, budget=None, device_count=8) == []
+
+
+def test_dlc512_pair_check_skips_overlap_programs_without_a_base():
+    """multi_step_dp_overlap has no multi_step_dp sibling in the audit —
+    the pair invariant must skip it, not crash or false-positive."""
+    solo = [_program(name="multi_step_dp_overlap", overlap=0.0)]
+    assert violations_for(solo, budget=None, device_count=8) == []
+
+
+def test_dlc512_fires_when_the_score_falls_below_the_committed_budget():
+    violations = violations_for(
+        [_program(overlap=5.0)], _budget(overlap=6.0), device_count=8
+    )
+    assert [v.rule for v in violations] == [AUDIT_RULE_OVERLAP]
+    assert "fell below the committed budget" in violations[0].message
+
+
+def test_dlc512_quiet_at_or_above_the_committed_score():
+    assert (
+        violations_for([_program(overlap=6.0)], _budget(overlap=6.0),
+                       device_count=8)
+        == []
+    )
+    assert (
+        violations_for([_program(overlap=7.0)], _budget(overlap=6.0),
+                       device_count=8)
+        == []
+    )
+
+
+def test_dlc512_skips_budgets_that_predate_the_overlap_field():
+    """An old committed budget with no overlap_score key must not
+    compare against the measured score (None is not a ratchet)."""
+    budget = _budget()
+    del budget["programs"]["train_step"]["overlap_score"]
+    assert (
+        violations_for([_program(overlap=0.0)], budget, device_count=8) == []
+    )
+
+
 def test_budget_roundtrips_through_disk(tmp_path):
     path = tmp_path / "comms_budget.json"
     program = _program()
@@ -224,15 +390,21 @@ def real_comms_audit(tmp_path_factory):
 def test_real_audit_budgets_every_program(real_comms_audit):
     report, _ = real_comms_audit
     budgets = {p.name: p.budget for p in report.programs}
-    assert set(budgets) == {"train_step", "multi_step", "serve_decode"}
+    assert set(budgets) == AUDITED_PROGRAMS
     for name, budget in budgets.items():
         assert budget["peak_hbm_bytes"] > 0, name
         for value in budget.values():
             assert value >= 0
-    # The fsdp train step must actually communicate on an 8-way mesh.
+    # The fsdp train step must actually communicate on an 8-way mesh,
+    # and the bucketed dp program must strictly beat the monolithic one
+    # on schedule slack — the number DLC512 ratchets.
     if report.device_count == 8:
         assert budgets["train_step"]["collective_count"] > 0
         assert budgets["train_step"]["collective_bytes"] > 0
+        assert (
+            budgets["train_step_dp_overlap"]["overlap_score"]
+            > budgets["train_step_dp"]["overlap_score"]
+        )
 
 
 def test_real_audit_matches_the_committed_budget(real_comms_audit):
@@ -268,9 +440,12 @@ def test_real_audit_journals_to_the_flight_recorder(real_comms_audit):
     events = list(read_journal(journal, kind="comms_audit"))
     assert len(events) == 1
     event = events[0]
-    assert set(event["programs"]) == {"train_step", "multi_step", "serve_decode"}
+    assert set(event["programs"]) == AUDITED_PROGRAMS
     assert event["device_count"] == report.device_count
     for program in event["programs"].values():
-        assert {"collective_count", "collective_bytes", "peak_hbm_bytes"} <= set(
-            program
-        )
+        assert {
+            "collective_count",
+            "collective_bytes",
+            "peak_hbm_bytes",
+            "overlap_score",
+        } <= set(program)

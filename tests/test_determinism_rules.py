@@ -8,7 +8,7 @@ explicit ``select`` — exactly how the runner enables it under
 ``dlcfn lint --determinism``.  Fixture paths live under ``chaos/``
 because the pass scopes itself to the determinism-bearing tree (chaos/,
 sched/, cluster/, obs/, train/datastream/, serve/loadgen.py,
-analysis/schedules.py).
+analysis/schedules.py, parallel/overlap.py).
 """
 
 import textwrap
@@ -61,12 +61,44 @@ def test_rules_scope_to_the_determinism_tree():
         "deeplearning_cfn_tpu/train/datastream/x.py",
         "deeplearning_cfn_tpu/serve/loadgen.py",
         "deeplearning_cfn_tpu/analysis/schedules.py",
+        "deeplearning_cfn_tpu/parallel/overlap.py",
     ):
         assert rules_for(src, {"DLC601"}, path=p) == ["DLC601"], p
-    # serve/ generally is out of scope; only loadgen.py is in.
+    # serve/ generally is out of scope; only loadgen.py is in.  Same
+    # for parallel/: only the bucket planner's output order is an SPMD
+    # contract, sharding.py stays DLC5xx's beat.
     assert rules_for(
         src, {"DLC601"}, path="deeplearning_cfn_tpu/serve/server.py"
     ) == []
+    assert rules_for(
+        src, {"DLC601"}, path="deeplearning_cfn_tpu/parallel/sharding.py"
+    ) == []
+
+
+def test_set_order_bucket_fold_fires_at_the_overlap_path():
+    """The exact hazard that put overlap.py in scope: folding parameter
+    leaves into buckets in set order would give each host a different
+    bucket sequence — a collective-order mismatch, i.e. a deadlock.
+    The planner's sorted-``keystr`` idiom is the sanctioned spelling."""
+    OVERLAP = "deeplearning_cfn_tpu/parallel/overlap.py"
+    bad = """\
+        def plan(leaves):
+            pending = {path for path, _ in leaves}
+            buckets = []
+            for path in pending:
+                buckets.append(path)
+            return buckets
+    """
+    assert rules_for(bad, {"DLC602"}, path=OVERLAP) == ["DLC602"]
+    good = """\
+        def plan(leaves):
+            pending = {path for path, _ in leaves}
+            buckets = []
+            for path in sorted(pending):
+                buckets.append(path)
+            return buckets
+    """
+    assert rules_for(good, {"DLC602"}, path=OVERLAP) == []
 
 
 def test_noqa_suppresses_with_reason():

@@ -594,6 +594,46 @@ def test_cli_status_requires_a_source():
         main(["status"])
 
 
+def test_cli_status_exports_comms_overlap_score_gauge(tmp_path, capsys):
+    """The DLC512-ratcheted schedule-slack number must survive the whole
+    export chain: comms_audit journal event -> fold_comms_events ->
+    `dlcfn status --format prom` as dlcfn_comms_overlap_score."""
+    from deeplearning_cfn_tpu.cli import main
+
+    path = tmp_path / "flight.jsonl"
+    rec = FlightRecorder(path=path)
+    rec.record(
+        "comms_audit",
+        clean=True,
+        device_count=8,
+        programs={
+            "train_step_dp": {
+                "collective_count": 6,
+                "collective_bytes": 70680,
+                "peak_hbm_bytes": 210860,
+                "overlap_score": 3.0,
+            },
+            "train_step_dp_overlap": {
+                "collective_count": 4,
+                "collective_bytes": 70680,
+                "peak_hbm_bytes": 210924,
+                "overlap_score": 3.75,
+            },
+        },
+    )
+    rec.close()
+    assert main(["status", "--journal", str(path), "--format", "prom"]) == 0
+    text = capsys.readouterr().out
+    assert "# TYPE dlcfn_comms_overlap_score gauge" in text
+    assert (
+        'dlcfn_comms_overlap_score{program="train_step_dp"} 3.0' in text
+    )
+    assert (
+        'dlcfn_comms_overlap_score{program="train_step_dp_overlap"} 3.75'
+        in text
+    )
+
+
 def test_cli_status_spans_from_journal(tmp_path, capsys):
     from deeplearning_cfn_tpu.cli import main
 

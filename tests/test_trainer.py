@@ -94,6 +94,45 @@ def test_infer_param_sharding_replicates_small_arrays():
     assert sh["bias"].spec == P()  # too small to shard
 
 
+def test_fsdp_spec_min_shard_elems_boundary_is_strict():
+    """The size gate is `< min_shard_elems`: exactly 2**14 elements is
+    big enough to shard; one element fewer is replicated.  The comms-
+    overlap bucket planner keys off this spec, so the boundary is
+    load-bearing, not cosmetic."""
+    from deeplearning_cfn_tpu.parallel.sharding import _fsdp_spec_for_array
+
+    mesh = build_mesh(MeshSpec(fsdp=8))
+    at_threshold = jnp.zeros((128, 128))  # 2**14 exactly
+    assert _fsdp_spec_for_array(at_threshold, mesh) == P("fsdp", None)
+    just_under = jnp.zeros((128, 127))
+    assert _fsdp_spec_for_array(just_under, mesh) == P()
+
+
+def test_fsdp_spec_shards_1d_and_prefers_the_largest_divisible_dim():
+    from deeplearning_cfn_tpu.parallel.sharding import _fsdp_spec_for_array
+
+    mesh = build_mesh(MeshSpec(fsdp=8))
+    # A big 1-D leaf (embeddings flattened, fused scales) shards too.
+    assert _fsdp_spec_for_array(jnp.zeros((2**14,)), mesh) == P("fsdp")
+    # Largest dim wins when divisible; otherwise fall through to the
+    # next-largest that is.
+    assert _fsdp_spec_for_array(jnp.zeros((512, 256)), mesh) == P("fsdp", None)
+    assert _fsdp_spec_for_array(jnp.zeros((513, 256)), mesh) == P(None, "fsdp")
+
+
+def test_fsdp_spec_replicates_when_nothing_divides_or_axis_trivial():
+    from deeplearning_cfn_tpu.parallel.sharding import _fsdp_spec_for_array
+
+    mesh = build_mesh(MeshSpec(fsdp=8))
+    # Big, but no dimension divisible by the 8-way fsdp axis.
+    assert _fsdp_spec_for_array(jnp.zeros((4099, 5)), mesh) == P()
+    # Scalars never shard regardless of the axis.
+    assert _fsdp_spec_for_array(jnp.zeros(()), mesh) == P()
+    # A trivial fsdp axis replicates everything (dp-only meshes).
+    dp_mesh = build_mesh(MeshSpec(dp=8))
+    assert _fsdp_spec_for_array(jnp.zeros((512, 512)), dp_mesh) == P()
+
+
 def test_remat_and_bf16_compile():
     mesh = build_mesh(MeshSpec(dp=8))
     trainer = Trainer(
